@@ -1,0 +1,22 @@
+"""juicefs_tpu — a TPU-native distributed POSIX file system.
+
+Capability target: the JuiceFS architecture (see SURVEY.md) — a pluggable
+transactional metadata engine plus an object-storage data plane that splits
+files into 64 MiB chunks / write-once slices / 4 MiB blocks — with the block
+data plane (content hashing, compression, content-addressed dedup scanning)
+running as batched JAX kernels on TPU behind the chunk-store boundary.
+
+Layer map (mirrors reference layers, SURVEY.md §1):
+
+    cmd/      CLI driver (format, mount, bench, gc, fsck, sync, ...)
+    fuse/     kernel adapter (FUSE protocol server)
+    vfs/      VFS core: handles, DataReader, DataWriter, control files
+    meta/     metadata engine: Meta interface, BaseMeta, TKV engines
+    chunk/    chunk store: pages, block cache, write pipeline
+    object/   object storage abstraction + wrappers
+    compress/ block compressors (none / lz4 / zstd)
+    tpu/      TPU data plane: JTH-256 hashing, dedup scan, sharded pipelines
+    utils/    logging, codecs, small shared helpers
+"""
+
+__version__ = "0.1.0"

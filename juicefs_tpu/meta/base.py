@@ -1,0 +1,603 @@
+"""Engine-agnostic metadata logic (reference: pkg/meta/base.go baseMeta:147).
+
+BaseMeta owns everything that does not touch the KV/SQL wire: permission
+checks, name validation, path resolution, open-file cache, session lifecycle,
+background-job hooks, message callbacks (slice deletion, compaction), statfs,
+recursive tools (summary, rmr). Engines implement the `do_*` methods
+(reference base.go:51-125 internal `engine` interface).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils import get_logger
+from . import interface
+from .context import Context
+from .openfile import OpenFiles
+from .types import (
+    Attr,
+    Entry,
+    Format,
+    Session,
+    Slice,
+    Summary,
+    CHUNK_SIZE,
+    MAX_NAME_LEN,
+    MAX_SYMLINK_LEN,
+    ROOT_INODE,
+    TRASH_INODE,
+    SET_ATTR_GID,
+    SET_ATTR_MODE,
+    SET_ATTR_UID,
+    TYPE_DIRECTORY,
+    TYPE_FILE,
+    TYPE_SYMLINK,
+    new_session_info,
+)
+
+logger = get_logger("meta.base")
+
+MODE_MASK_R = 4
+MODE_MASK_W = 2
+MODE_MASK_X = 1
+
+_UMOUNTED, _MOUNTED = 0, 1
+
+
+class BaseMeta(interface.Meta):
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.fmt: Format = Format()
+        self.sid: int = 0
+        self.of = OpenFiles()
+        self.msg_callbacks: dict[int, Callable] = {}
+        self._lock = threading.Lock()
+        # batched id allocation (reference base.go:946 freeID batching)
+        self._free_inodes = _IDBatch()
+        self._free_slices = _IDBatch()
+        self._heartbeat: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- abstract engine ops (reference base.go:51-125) --------------------
+    def do_init(self, fmt: Format, force: bool) -> int: ...
+    def do_load(self) -> Optional[bytes]: ...
+    def do_new_session(self, info: Session) -> int: ...
+    def do_refresh_session(self, sid: int) -> None: ...
+    def do_clean_session(self, sid: int) -> None: ...
+    def do_list_sessions(self) -> list[Session]: ...
+    def do_reset(self) -> None: ...
+    def do_new_inodes(self, n: int) -> int: ...
+    def do_new_slices(self, n: int) -> int: ...
+    def do_lookup(self, parent: int, name: bytes) -> tuple[int, int, Attr]: ...
+    def do_getattr(self, ino: int) -> tuple[int, Attr]: ...
+    def do_setattr(self, ctx, ino, flags, attr: Attr) -> tuple[int, Attr]: ...
+    def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path) -> tuple[int, int, Attr]: ...
+    def do_unlink(self, ctx, parent, name, skip_trash=False) -> int: ...
+    def do_rmdir(self, ctx, parent, name, skip_trash=False) -> int: ...
+    def do_rename(self, ctx, psrc, nsrc, pdst, ndst, flags) -> tuple[int, int, Attr]: ...
+    def do_link(self, ctx, ino, parent, name) -> tuple[int, Attr]: ...
+    def do_readdir(self, ctx, ino, want_attr: bool) -> tuple[int, list[Entry]]: ...
+    def do_readlink(self, ino) -> tuple[int, bytes]: ...
+    def do_truncate(self, ctx, ino, length) -> tuple[int, Attr]: ...
+    def do_fallocate(self, ctx, ino, mode, off, size) -> int: ...
+    def do_read_chunk(self, ino, indx) -> tuple[int, list[Slice]]: ...
+    def do_write_chunk(self, ino, indx, pos, slc: Slice, length_hint: int, incref: bool = False) -> int: ...
+    def do_getxattr(self, ino, name) -> tuple[int, bytes]: ...
+    def do_setxattr(self, ino, name, value, flags) -> int: ...
+    def do_listxattr(self, ino) -> tuple[int, list[bytes]]: ...
+    def do_removexattr(self, ino, name) -> int: ...
+    def do_statfs(self) -> tuple[int, int, int, int]: ...
+    def do_delete_sustained(self, sid: int, ino: int) -> None: ...
+    def do_find_deleted_files(self, limit: int) -> dict[int, int]: ...
+    def do_delete_file_data(self, ino: int, length: int) -> None: ...
+    def do_list_slices(self) -> dict[int, list[Slice]]: ...
+    def do_counter(self, name: str, delta: int = 0) -> int: ...
+
+    # -- lifecycle ---------------------------------------------------------
+    def name(self) -> str:
+        return "base"
+
+    def init(self, fmt: Format, force: bool = False) -> int:
+        """Create/overwrite the volume format record (reference cmd/format.go)."""
+        return self.do_init(fmt, force)
+
+    def load(self, check_version: bool = True) -> Format:
+        """Load Format JSON from the engine (reference base.go:317)."""
+        data = self.do_load()
+        if data is None:
+            raise RuntimeError(f"database is not formatted: {self.addr}")
+        self.fmt = Format.from_json(data)
+        return self.fmt
+
+    def new_session(self, record: bool = True, heartbeat: float = 0.0) -> int:
+        """Register a client session (reference base.go:371 NewSession)."""
+        if record:
+            self.sid = self.do_new_session(new_session_info())
+            if heartbeat > 0:
+                self._heartbeat = threading.Thread(
+                    target=self._session_refresher, args=(heartbeat,), daemon=True
+                )
+                self._heartbeat.start()
+        return self.sid
+
+    def close_session(self) -> None:
+        self._stop.set()
+        if self.sid:
+            self.do_clean_session(self.sid)
+            self.sid = 0
+
+    def _session_refresher(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.do_refresh_session(self.sid)
+            except Exception as e:  # pragma: no cover - background resilience
+                logger.warning("session refresh failed: %s", e)
+
+    def on_msg(self, mtype: int, callback: Callable) -> None:
+        """Register DELETE_SLICE / COMPACT_CHUNK callback
+        (reference interface.go OnMsg, cmd/mount.go:271 registerMetaMsg)."""
+        self.msg_callbacks[mtype] = callback
+
+    def _notify(self, mtype: int, *args) -> None:
+        cb = self.msg_callbacks.get(mtype)
+        if cb is not None:
+            cb(*args)
+
+    def reset(self) -> None:
+        self.do_reset()
+
+    # -- permissions -------------------------------------------------------
+    def access(self, ctx: Context, ino: int, mask: int, attr: Optional[Attr] = None) -> int:
+        """POSIX rwx check (reference base.go Access)."""
+        if ctx.uid == 0 or not ctx.check_permission:
+            return 0
+        if attr is None or not attr.full:
+            st, attr = self.do_getattr(ino)
+            if st:
+                return st
+        mode = self._access_mode(attr, ctx)
+        if mode & mask != mask:
+            return errno.EACCES
+        return 0
+
+    @staticmethod
+    def _access_mode(attr: Attr, ctx: Context) -> int:
+        if ctx.uid == 0:
+            return 7
+        if ctx.uid == attr.uid:
+            return (attr.mode >> 6) & 7
+        if ctx.contains_gid(attr.gid):
+            return (attr.mode >> 3) & 7
+        return attr.mode & 7
+
+    @staticmethod
+    def check_name(name: bytes) -> int:
+        if len(name) == 0:
+            return errno.EINVAL
+        if len(name) > MAX_NAME_LEN:
+            return errno.ENAMETOOLONG
+        return 0
+
+    # -- namespace ops -----------------------------------------------------
+    def lookup(self, ctx: Context, parent: int, name: bytes) -> tuple[int, int, Attr]:
+        if name == b"..":
+            st, pattr = self.do_getattr(parent)
+            if st:
+                return st, 0, Attr()
+            if pattr.typ != TYPE_DIRECTORY:
+                return errno.ENOTDIR, 0, Attr()
+            st, gattr = self.do_getattr(pattr.parent)
+            return st, pattr.parent, gattr
+        if name == b".":
+            st, attr = self.do_getattr(parent)
+            return st, parent, attr
+        st = self.access(ctx, parent, MODE_MASK_X)
+        if st:
+            return st, 0, Attr()
+        st, ino, attr = self.do_lookup(parent, name)
+        if st:
+            return st, 0, Attr()
+        return 0, ino, attr
+
+    def resolve(self, ctx: Context, path: str) -> tuple[int, int, Attr]:
+        """Walk an absolute path from root (reference pkg/fs path walk)."""
+        ino = ROOT_INODE
+        st, attr = self.do_getattr(ino)
+        if st:
+            return st, 0, Attr()
+        for part in path.strip("/").split("/"):
+            if not part:
+                continue
+            st, ino, attr = self.lookup(ctx, ino, part.encode())
+            if st:
+                return st, 0, Attr()
+        return 0, ino, attr
+
+    def getattr(self, ctx: Context, ino: int) -> tuple[int, Attr]:
+        cached = self.of.attr(ino)
+        if cached is not None:
+            return 0, cached
+        st, attr = self.do_getattr(ino)
+        if st == 0:
+            self.of.update(ino, attr)
+        return st, attr
+
+    def setattr(self, ctx: Context, ino: int, flags: int, attr: Attr) -> tuple[int, Attr]:
+        st, cur = self.do_getattr(ino)
+        if st:
+            return st, Attr()
+        if ctx.uid != 0 and ctx.check_permission:
+            if flags & SET_ATTR_MODE and ctx.uid != cur.uid:
+                return errno.EPERM, Attr()
+            if flags & SET_ATTR_UID and (ctx.uid != cur.uid or attr.uid != cur.uid):
+                return errno.EPERM, Attr()
+            if flags & SET_ATTR_GID:
+                if ctx.uid != cur.uid:
+                    return errno.EPERM, Attr()
+                if attr.gid != cur.gid and not ctx.contains_gid(attr.gid):
+                    return errno.EPERM, Attr()
+        st, out = self.do_setattr(ctx, ino, flags, attr)
+        if st == 0:
+            self.of.invalidate(ino)
+        return st, out
+
+    def mknod(
+        self,
+        ctx: Context,
+        parent: int,
+        name: bytes,
+        typ: int,
+        mode: int,
+        cumask: int = 0,
+        rdev: int = 0,
+        path: bytes = b"",
+    ) -> tuple[int, int, Attr]:
+        st = self.check_name(name)
+        if st:
+            return st, 0, Attr()
+        if typ == TYPE_SYMLINK and len(path) > MAX_SYMLINK_LEN:
+            return errno.ENAMETOOLONG, 0, Attr()
+        st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
+        if st:
+            return st, 0, Attr()
+        return self.do_mknod(ctx, parent, name, typ, mode, cumask, rdev, path)
+
+    def mkdir(self, ctx, parent, name, mode, cumask=0) -> tuple[int, int, Attr]:
+        return self.mknod(ctx, parent, name, TYPE_DIRECTORY, mode, cumask)
+
+    def create(self, ctx, parent, name, mode, cumask=0, flags=0) -> tuple[int, int, Attr]:
+        st, ino, attr = self.mknod(ctx, parent, name, TYPE_FILE, mode, cumask)
+        if st == errno.EEXIST and not flags & os.O_EXCL:
+            st, ino, attr = self.lookup(ctx, parent, name)
+            if st == 0 and attr.typ != TYPE_FILE:
+                return errno.EISDIR if attr.typ == TYPE_DIRECTORY else errno.EEXIST, 0, Attr()
+        if st == 0:
+            self.of.open(ino, attr)
+        return st, ino, attr
+
+    def symlink(self, ctx, parent, name, target: bytes) -> tuple[int, int, Attr]:
+        return self.mknod(ctx, parent, name, TYPE_SYMLINK, 0o777, 0, 0, target)
+
+    def readlink(self, ctx, ino) -> tuple[int, bytes]:
+        return self.do_readlink(ino)
+
+    def unlink(self, ctx, parent, name, skip_trash=False) -> int:
+        st = self.check_name(name)
+        if st:
+            return st
+        st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
+        if st:
+            return st
+        return self.do_unlink(ctx, parent, name, skip_trash)
+
+    def rmdir(self, ctx, parent, name, skip_trash=False) -> int:
+        if name == b"." :
+            return errno.EINVAL
+        if name == b"..":
+            return errno.ENOTEMPTY
+        st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
+        if st:
+            return st
+        return self.do_rmdir(ctx, parent, name, skip_trash)
+
+    def rename(self, ctx, psrc, nsrc, pdst, ndst, flags=0) -> tuple[int, int, Attr]:
+        st = self.check_name(ndst)
+        if st:
+            return st, 0, Attr()
+        st = self.access(ctx, psrc, MODE_MASK_W | MODE_MASK_X)
+        if st:
+            return st, 0, Attr()
+        st = self.access(ctx, pdst, MODE_MASK_W | MODE_MASK_X)
+        if st:
+            return st, 0, Attr()
+        st, ino, attr = self.do_rename(ctx, psrc, nsrc, pdst, ndst, flags)
+        if st == 0:
+            self.of.invalidate(ino)
+        return st, ino, attr
+
+    def link(self, ctx, ino, parent, name) -> tuple[int, Attr]:
+        st = self.check_name(name)
+        if st:
+            return st, Attr()
+        st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
+        if st:
+            return st, Attr()
+        st, attr = self.do_link(ctx, ino, parent, name)
+        if st == 0:
+            self.of.invalidate(ino)
+        return st, attr
+
+    def readdir(self, ctx, ino, want_attr: bool = False) -> tuple[int, list[Entry]]:
+        st = self.access(ctx, ino, MODE_MASK_R)
+        if st:
+            return st, []
+        st, entries = self.do_readdir(ctx, ino, want_attr)
+        if st:
+            return st, []
+        st2, attr = self.do_getattr(ino)
+        if st2 == 0:
+            entries.insert(0, Entry(inode=ino, name=b".", attr=attr))
+            st3, pattr = self.do_getattr(attr.parent or ino)
+            entries.insert(
+                1, Entry(inode=attr.parent or ino, name=b"..", attr=pattr if st3 == 0 else Attr(typ=TYPE_DIRECTORY))
+            )
+        return 0, entries
+
+    # -- open-file lifecycle ----------------------------------------------
+    def open(self, ctx, ino, flags) -> tuple[int, Attr]:
+        st, attr = self.do_getattr(ino)
+        if st:
+            return st, Attr()
+        if attr.typ != TYPE_FILE:
+            return errno.EPERM, Attr()
+        if ctx.check_permission:
+            mask = 0
+            accmode = flags & os.O_ACCMODE
+            if accmode in (os.O_RDONLY, os.O_RDWR):
+                mask |= MODE_MASK_R
+            if accmode in (os.O_WRONLY, os.O_RDWR):
+                mask |= MODE_MASK_W
+            st = self.access(ctx, ino, mask, attr)
+            if st:
+                return st, Attr()
+        self.of.open(ino, attr)
+        return 0, attr
+
+    def close(self, ctx, ino) -> int:
+        if self.of.close(ino):
+            # last close: if unlinked while open, data can now be reclaimed
+            if self.sid:
+                self.do_delete_sustained(self.sid, ino)
+        return 0
+
+    # -- file data ---------------------------------------------------------
+    def new_slice(self) -> int:
+        """Allocate a globally-unique slice id (reference base.go NewSlice)."""
+        return self._free_slices.next(self.do_new_slices)
+
+    def new_inode(self) -> int:
+        return self._free_inodes.next(self.do_new_inodes)
+
+    def read_chunk(self, ino: int, indx: int) -> tuple[int, list[Slice]]:
+        cached = self.of.chunk(ino, indx)
+        if cached is not None:
+            return 0, cached
+        st, slices = self.do_read_chunk(ino, indx)
+        if st == 0:
+            self.of.cache_chunk(ino, indx, slices)
+        return st, slices
+
+    def write_chunk(self, ino: int, indx: int, pos: int, slc: Slice) -> int:
+        if indx < 0 or pos + slc.len > CHUNK_SIZE:
+            return errno.EINVAL
+        st = self.do_write_chunk(ino, indx, pos, slc, indx * CHUNK_SIZE + pos + slc.len)
+        self.of.invalidate(ino)  # cached attr (length/mtime) and chunks are stale
+        return st
+
+    def truncate(self, ctx, ino, length, skip_perm=False) -> tuple[int, Attr]:
+        if not skip_perm:
+            st, attr = self.do_getattr(ino)
+            if st:
+                return st, Attr()
+            st = self.access(ctx, ino, MODE_MASK_W, attr)
+            if st:
+                return st, Attr()
+        st, attr = self.do_truncate(ctx, ino, length)
+        if st == 0:
+            self.of.invalidate(ino)
+        return st, attr
+
+    def fallocate(self, ctx, ino, mode, off, size) -> int:
+        if off < 0 or size <= 0:
+            return errno.EINVAL
+        st = self.do_fallocate(ctx, ino, mode, off, size)
+        if st == 0:
+            self.of.invalidate(ino)
+        return st
+
+    def copy_file_range(
+        self, ctx, fin, offin, fout, offout, size, flags
+    ) -> tuple[int, int]:
+        """Server-side copy by sharing slice references
+        (reference base.go CopyFileRange)."""
+        if flags:
+            return errno.EINVAL, 0
+        st, attr = self.do_getattr(fin)
+        if st:
+            return st, 0
+        if offin >= attr.length:
+            return 0, 0
+        size = min(size, attr.length - offin)
+        copied = 0
+        while copied < size:
+            indx = (offin + copied) // CHUNK_SIZE
+            pos = (offin + copied) % CHUNK_SIZE
+            n = min(CHUNK_SIZE - pos, size - copied)
+            st, slices = self.do_read_chunk(fin, indx)
+            if st:
+                return st, copied
+            from .slice import build_slice
+
+            view = build_slice(slices)
+            dindx = (offout + copied) // CHUNK_SIZE
+            dpos = (offout + copied) % CHUNK_SIZE
+            if dpos + n > CHUNK_SIZE:
+                n = CHUNK_SIZE - dpos
+            cur = pos
+            end = pos + n
+            for seg in view:
+                s0 = max(seg.pos, cur)
+                s1 = min(seg.pos + seg.len, end)
+                if s1 <= s0:
+                    continue
+                new = Slice(
+                    pos=dpos + (s0 - pos),
+                    id=seg.id,
+                    size=seg.size,
+                    off=seg.off + (s0 - seg.pos),
+                    len=s1 - s0,
+                )
+                # incref: destination shares the source's stored slice
+                st = self.do_write_chunk(
+                    fout, dindx, new.pos, new,
+                    dindx * CHUNK_SIZE + new.pos + new.len, incref=True,
+                )
+                if st:
+                    return st, copied
+                cur = s1
+            if cur < end:  # trailing hole
+                hole = Slice(pos=dpos + (cur - pos), id=0, size=end - cur, off=0, len=end - cur)
+                st = self.do_write_chunk(fout, dindx, hole.pos, hole, dindx * CHUNK_SIZE + hole.pos + hole.len)
+                if st:
+                    return st, copied
+            copied += n
+        return 0, copied
+
+    # -- xattr -------------------------------------------------------------
+    def getxattr(self, ctx, ino, name: bytes) -> tuple[int, bytes]:
+        return self.do_getxattr(ino, name)
+
+    def setxattr(self, ctx, ino, name: bytes, value: bytes, flags: int = 0) -> int:
+        if not name:
+            return errno.EINVAL
+        return self.do_setxattr(ino, name, value, flags)
+
+    def listxattr(self, ctx, ino) -> tuple[int, list[bytes]]:
+        return self.do_listxattr(ino)
+
+    def removexattr(self, ctx, ino, name: bytes) -> int:
+        return self.do_removexattr(ino, name)
+
+    # -- admin / tools -----------------------------------------------------
+    def statfs(self, ctx) -> tuple[int, int, int, int]:
+        """(total_bytes, avail_bytes, used_inodes, avail_inodes)
+        (reference base.go StatFS)."""
+        return self.do_statfs()
+
+    def summary(self, ctx, ino: int) -> tuple[int, Summary]:
+        """du aggregate over a subtree (reference base.go GetSummary)."""
+        st, attr = self.do_getattr(ino)
+        if st:
+            return st, Summary()
+        s = Summary()
+        self._summarize(ctx, ino, attr, s)
+        return 0, s
+
+    def _summarize(self, ctx, ino, attr, s: Summary) -> None:
+        if attr.typ == TYPE_DIRECTORY:
+            s.dirs += 1
+            s.size += 4096
+            st, entries = self.do_readdir(ctx, ino, True)
+            if st:
+                return
+            for e in entries:
+                self._summarize(ctx, e.inode, e.attr, s)
+        else:
+            s.files += 1
+            s.length += attr.length
+            s.size += (attr.length + 4095) // 4096 * 4096
+
+    def remove_recursive(self, ctx, parent: int, name: bytes, skip_trash=False) -> tuple[int, int]:
+        """rmr: depth-first delete (reference base.go Remove / cmd rmr)."""
+        st, ino, attr = self.lookup(ctx, parent, name)
+        if st:
+            return st, 0
+        removed = 0
+        if attr.typ == TYPE_DIRECTORY:
+            st, entries = self.do_readdir(ctx, ino, True)
+            if st:
+                return st, removed
+            for e in entries:
+                st2, n = self.remove_recursive(ctx, ino, e.name, skip_trash)
+                removed += n
+                if st2:
+                    return st2, removed
+            st = self.do_rmdir(ctx, parent, name, skip_trash)
+        else:
+            st = self.do_unlink(ctx, parent, name, skip_trash)
+        if st == 0:
+            removed += 1
+        return st, removed
+
+    def get_paths(self, ino: int) -> list[str]:
+        """Reverse-resolve inode to path(s) (reference base.go GetPaths)."""
+        if ino == ROOT_INODE:
+            return ["/"]
+        st, attr = self.do_getattr(ino)
+        if st:
+            return []
+        paths: list[str] = []
+        if attr.parent:
+            st, entries = self.do_readdir(Context(check_permission=False), attr.parent, False)
+            if st == 0:
+                for e in entries:
+                    if e.inode == ino:
+                        for p in self.get_paths(attr.parent) or []:
+                            paths.append(p.rstrip("/") + "/" + e.name.decode("utf-8", "replace"))
+        return paths
+
+    # -- background cleanup ------------------------------------------------
+    def cleanup_deleted_files(self, limit: int = 1000) -> int:
+        """Reclaim data of files whose last link was removed
+        (reference base.go cleanupDeletedFiles / doDeleteFileData)."""
+        files = self.do_find_deleted_files(limit)
+        for ino, length in files.items():
+            self.do_delete_file_data(ino, length)
+        return len(files)
+
+    def list_slices(self) -> dict[int, list[Slice]]:
+        """All live slices keyed by inode, for gc/fsck
+        (reference interface.go ListSlices)."""
+        return self.do_list_slices()
+
+    def used_space(self) -> int:
+        return self.do_counter("usedSpace")
+
+    def used_inodes(self) -> int:
+        return self.do_counter("totalInodes")
+
+
+class _IDBatch:
+    """Client-side batched allocation of inode/slice ids
+    (reference base.go:946 allocateInodes batching of 100/1000)."""
+
+    BATCH = 256
+
+    def __init__(self):
+        self._next = 0
+        self._end = 0
+        self._lock = threading.Lock()
+
+    def next(self, alloc: Callable[[int], int]) -> int:
+        with self._lock:
+            if self._next >= self._end:
+                start = alloc(self.BATCH)
+                self._next, self._end = start, start + self.BATCH
+            v = self._next
+            self._next += 1
+            return v

@@ -1,0 +1,1178 @@
+"""KV meta engine over an ordered-KV client
+(reference: pkg/meta/tkv.go kvMeta + key schema tkv.go:165-196).
+
+Binary key schema (big-endian; adapted from the reference's TKV schema,
+the cleanest of its three engines — SURVEY.md §7.1):
+
+    setting                      -> Format JSON
+    C{name}                      -> counter (i64): nextInode nextSlice
+                                    nextSession usedSpace totalInodes
+    A{ino8}I                     -> inode attribute (Attr codec)
+    A{ino8}D{name}               -> dentry: typ(1) + ino(8)
+    A{ino8}C{indx4}              -> chunk: concatenated 24B Slice records
+    A{ino8}S                     -> symlink target
+    A{ino8}X{name}               -> xattr value
+    A{ino8}P{parent8}            -> hard-link parent refcount (u32)
+    D{ino8}{length8}             -> deleted file pending data reclaim (ts f64)
+    K{sliceid8}{size4}           -> slice refcount delta (i64; absent == 1)
+    F{ino8}                      -> BSD flock table (JSON)
+    L{ino8}                      -> POSIX record locks (JSON)
+    SE{sid8} / SH{sid8}          -> session info (JSON) / heartbeat (f64)
+    SS{sid8}{ino8}               -> sustained (open-but-unlinked) inode
+    U{ino8}                      -> dir stats: length, space, inodes (3x i64)
+    QD{ino8}                     -> dir quota: space,inodes,used_space,used_inodes
+"""
+
+from __future__ import annotations
+
+import calendar
+import errno
+import json
+import struct
+import time
+from typing import Optional
+
+from ..utils import get_logger
+from . import interface
+from .base import BaseMeta
+from .context import Context
+from .slice import build_slice
+from .tkv_client import KVTxn, TKVClient, new_tkv_client, next_key
+from .types import (
+    Attr,
+    Entry,
+    Format,
+    Session,
+    Slice,
+    CHUNK_SIZE,
+    FLAG_APPEND,
+    FLAG_IMMUTABLE,
+    RENAME_EXCHANGE,
+    RENAME_NOREPLACE,
+    ROOT_INODE,
+    SET_ATTR_ATIME,
+    SET_ATTR_ATIME_NOW,
+    SET_ATTR_FLAG,
+    SET_ATTR_GID,
+    SET_ATTR_MODE,
+    SET_ATTR_MTIME,
+    SET_ATTR_MTIME_NOW,
+    SET_ATTR_SIZE,
+    SET_ATTR_UID,
+    TRASH_INODE,
+    TRASH_NAME,
+    TYPE_DIRECTORY,
+    TYPE_FILE,
+    TYPE_SYMLINK,
+)
+
+logger = get_logger("meta.kv")
+
+_I64 = struct.Struct(">q")
+_U32 = struct.Struct(">I")
+_F64 = struct.Struct(">d")
+
+
+def _align4k(length: int) -> int:
+    return (length + 4095) // 4096 * 4096 if length else 0
+
+
+class KVMeta(BaseMeta):
+    """Meta engine over any TKVClient (reference pkg/meta/tkv.go kvMeta)."""
+
+    def __init__(self, client: TKVClient, addr: str = ""):
+        super().__init__(addr)
+        self.client = client
+
+    def name(self) -> str:
+        return self.client.name
+
+    # ---- key builders (reference tkv.go:198-296) -------------------------
+    @staticmethod
+    def _ino_key(ino: int) -> bytes:
+        return b"A" + ino.to_bytes(8, "big")
+
+    def _attr_key(self, ino: int) -> bytes:
+        return self._ino_key(ino) + b"I"
+
+    def _entry_key(self, parent: int, name: bytes) -> bytes:
+        return self._ino_key(parent) + b"D" + name
+
+    def _chunk_key(self, ino: int, indx: int) -> bytes:
+        return self._ino_key(ino) + b"C" + indx.to_bytes(4, "big")
+
+    def _symlink_key(self, ino: int) -> bytes:
+        return self._ino_key(ino) + b"S"
+
+    def _xattr_key(self, ino: int, name: bytes) -> bytes:
+        return self._ino_key(ino) + b"X" + name
+
+    def _parent_key(self, ino: int, parent: int) -> bytes:
+        return self._ino_key(ino) + b"P" + parent.to_bytes(8, "big")
+
+    @staticmethod
+    def _counter_key(name: str) -> bytes:
+        return b"C" + name.encode()
+
+    @staticmethod
+    def _delfile_key(ino: int, length: int) -> bytes:
+        return b"D" + ino.to_bytes(8, "big") + length.to_bytes(8, "big")
+
+    @staticmethod
+    def _sliceref_key(sid: int, size: int) -> bytes:
+        return b"K" + sid.to_bytes(8, "big") + size.to_bytes(4, "big")
+
+    @staticmethod
+    def _flock_key(ino: int) -> bytes:
+        return b"F" + ino.to_bytes(8, "big")
+
+    @staticmethod
+    def _plock_key(ino: int) -> bytes:
+        return b"L" + ino.to_bytes(8, "big")
+
+    @staticmethod
+    def _session_key(sid: int) -> bytes:
+        return b"SE" + sid.to_bytes(8, "big")
+
+    @staticmethod
+    def _heartbeat_key(sid: int) -> bytes:
+        return b"SH" + sid.to_bytes(8, "big")
+
+    @staticmethod
+    def _sustained_key(sid: int, ino: int) -> bytes:
+        return b"SS" + sid.to_bytes(8, "big") + ino.to_bytes(8, "big")
+
+    @staticmethod
+    def _dirstat_key(ino: int) -> bytes:
+        return b"U" + ino.to_bytes(8, "big")
+
+    @staticmethod
+    def _dirquota_key(ino: int) -> bytes:
+        return b"QD" + ino.to_bytes(8, "big")
+
+    # ---- txn-scoped helpers ---------------------------------------------
+    def _get_attr(self, tx: KVTxn, ino: int) -> Optional[Attr]:
+        raw = tx.get(self._attr_key(ino))
+        return Attr.decode(raw) if raw else None
+
+    def _set_attr(self, tx: KVTxn, ino: int, attr: Attr) -> None:
+        tx.set(self._attr_key(ino), attr.encode())
+
+    def _get_entry(self, tx: KVTxn, parent: int, name: bytes) -> tuple[int, int]:
+        raw = tx.get(self._entry_key(parent, name))
+        if not raw:
+            return 0, 0
+        return raw[0], int.from_bytes(raw[1:9], "big")
+
+    def _set_entry(self, tx: KVTxn, parent: int, name: bytes, typ: int, ino: int) -> None:
+        tx.set(self._entry_key(parent, name), bytes([typ]) + ino.to_bytes(8, "big"))
+
+    def _scan_entries(self, tx: KVTxn, ino: int) -> list[tuple[bytes, int, int]]:
+        prefix = self._ino_key(ino) + b"D"
+        out = []
+        for k, v in tx.scan(prefix, next_key(prefix)):
+            out.append((k[len(prefix):], v[0], int.from_bytes(v[1:9], "big")))
+        return out
+
+    def _update_dirstat(self, tx: KVTxn, ino: int, dl: int, ds: int, di: int) -> None:
+        if not self.fmt.dir_stats or ino == 0:
+            return
+        key = self._dirstat_key(ino)
+        raw = tx.get(key)
+        l, s, i = struct.unpack(">qqq", raw) if raw else (0, 0, 0)
+        tx.set(key, struct.pack(">qqq", l + dl, s + ds, i + di))
+
+    def _update_used(self, tx: KVTxn, dspace: int, dinodes: int) -> int:
+        """Global usage counters + volume quota check (reference quota.go)."""
+        if dspace > 0 and self.fmt.capacity:
+            used = self._counter_get(tx, "usedSpace")
+            if used + dspace > self.fmt.capacity:
+                return errno.ENOSPC
+        if dinodes > 0 and self.fmt.inodes:
+            used = self._counter_get(tx, "totalInodes")
+            if used + dinodes > self.fmt.inodes:
+                return errno.ENOSPC
+        if dspace:
+            tx.incr_by(self._counter_key("usedSpace"), dspace)
+        if dinodes:
+            tx.incr_by(self._counter_key("totalInodes"), dinodes)
+        return 0
+
+    def _counter_get(self, tx: KVTxn, name: str) -> int:
+        raw = tx.get(self._counter_key(name))
+        return int.from_bytes(raw, "big", signed=True) if raw else 0
+
+    @staticmethod
+    def _sticky_violation(pattr: Attr, attr: Attr, ctx: Context) -> bool:
+        return (
+            ctx.check_permission
+            and ctx.uid != 0
+            and pattr.mode & 0o1000 != 0
+            and ctx.uid != pattr.uid
+            and ctx.uid != attr.uid
+        )
+
+    # ---- lifecycle -------------------------------------------------------
+    def do_init(self, fmt: Format, force: bool) -> int:
+        def fn(tx: KVTxn):
+            old = tx.get(b"setting")
+            if old is not None and not force:
+                prev = Format.from_json(old)
+                if prev.name != fmt.name:
+                    raise RuntimeError(
+                        f"volume already formatted as {prev.name}; use force to overwrite"
+                    )
+            tx.set(b"setting", fmt.to_json().encode())
+            if self._get_attr(tx, ROOT_INODE) is None:
+                now = time.time()
+                root = Attr(typ=TYPE_DIRECTORY, mode=0o777, nlink=2, length=4096)
+                root.parent = ROOT_INODE
+                root.touch_mtime(now)
+                root.touch_atime(now)
+                self._set_attr(tx, ROOT_INODE, root)
+                trash = Attr(typ=TYPE_DIRECTORY, mode=0o555, nlink=2, length=4096)
+                trash.parent = TRASH_INODE
+                trash.touch_mtime(now)
+                self._set_attr(tx, TRASH_INODE, trash)
+                tx.set(self._counter_key("nextInode"), (2).to_bytes(8, "big", signed=True))
+                tx.set(self._counter_key("nextSlice"), (1).to_bytes(8, "big", signed=True))
+            return 0
+
+        self.client.txn(fn)
+        self.fmt = fmt
+        return 0
+
+    def do_load(self) -> Optional[bytes]:
+        return self.client.txn(lambda tx: tx.get(b"setting"))
+
+    def do_reset(self) -> None:
+        self.client.reset()
+
+    def do_new_inodes(self, n: int) -> int:
+        end = self.client.txn(lambda tx: tx.incr_by(self._counter_key("nextInode"), n))
+        return end - n
+
+    def do_new_slices(self, n: int) -> int:
+        end = self.client.txn(lambda tx: tx.incr_by(self._counter_key("nextSlice"), n))
+        return end - n
+
+    def do_counter(self, name: str, delta: int = 0) -> int:
+        if delta:
+            return self.client.txn(lambda tx: tx.incr_by(self._counter_key(name), delta))
+        return self.client.txn(lambda tx: self._counter_get(tx, name))
+
+    # ---- sessions --------------------------------------------------------
+    def do_new_session(self, info: Session) -> int:
+        def fn(tx: KVTxn):
+            sid = tx.incr_by(self._counter_key("nextSession"), 1)
+            info.sid = sid
+            tx.set(self._session_key(sid), info.to_json().encode())
+            tx.set(self._heartbeat_key(sid), _F64.pack(time.time()))
+            return sid
+
+        return self.client.txn(fn)
+
+    def do_refresh_session(self, sid: int) -> None:
+        self.client.txn(lambda tx: tx.set(self._heartbeat_key(sid), _F64.pack(time.time())))
+
+    def do_clean_session(self, sid: int) -> None:
+        """Release a session: reclaim sustained inodes, drop its locks
+        (reference base.go:504 CleanStaleSessions / doCleanStaleSession)."""
+        prefix = b"SS" + sid.to_bytes(8, "big")
+        sustained = [
+            int.from_bytes(k[len(prefix):], "big") for k, _ in self.client.scan(prefix, next_key(prefix))
+        ]
+        for ino in sustained:
+            self.do_delete_sustained(sid, ino)
+
+        def fn(tx: KVTxn):
+            tx.delete(self._session_key(sid))
+            tx.delete(self._heartbeat_key(sid))
+            return 0
+
+        self.client.txn(fn)
+        # drop this session's locks
+        for kind in (b"F", b"L"):
+            for k, v in list(self.client.scan(kind, next_key(kind))):
+                if len(k) != 9:
+                    continue
+                try:
+                    table = json.loads(v)
+                except ValueError:
+                    continue
+                if isinstance(table, dict):  # flock: {"sid/owner": type}
+                    keep = {o: r for o, r in table.items() if not o.startswith(f"{sid}/")}
+                    changed = len(keep) != len(table)
+                else:  # plock: [[sid, owner, ltype, start, end, pid], ...]
+                    keep = [l for l in table if l[0] != sid]
+                    changed = len(keep) != len(table)
+                if changed:
+                    self.client.txn(
+                        lambda tx, k=k, keep=keep: tx.set(k, json.dumps(keep).encode())
+                        if keep
+                        else tx.delete(k)
+                    )
+
+    def do_list_sessions(self) -> list[Session]:
+        out = []
+        for _, v in self.client.scan(b"SE", next_key(b"SE")):
+            try:
+                out.append(Session.from_json(v))
+            except ValueError:
+                pass
+        return out
+
+    def clean_stale_sessions(self, age: float = 300.0) -> int:
+        """GC sessions whose heartbeat is older than `age` seconds."""
+        cleaned = 0
+        now = time.time()
+        for k, v in list(self.client.scan(b"SH", next_key(b"SH"))):
+            if len(k) == 10 and now - _F64.unpack(v)[0] > age:
+                self.do_clean_session(int.from_bytes(k[2:], "big"))
+                cleaned += 1
+        return cleaned
+
+    def do_delete_sustained(self, sid: int, ino: int) -> None:
+        # usedSpace/totalInodes were already decremented when the file was
+        # unlinked into the sustained set; only the data reclaim is deferred.
+        def fn(tx: KVTxn):
+            tx.delete(self._sustained_key(sid, ino))
+            attr = self._get_attr(tx, ino)
+            if attr is not None and attr.nlink == 0:
+                tx.delete(self._attr_key(ino))
+                tx.set(self._delfile_key(ino, attr.length), _F64.pack(time.time()))
+            return 0
+
+        self.client.txn(fn)
+
+    # ---- attrs -----------------------------------------------------------
+    def do_getattr(self, ino: int) -> tuple[int, Attr]:
+        attr = self.client.simple_txn(lambda tx: self._get_attr(tx, ino))
+        if attr is None:
+            return errno.ENOENT, Attr()
+        return 0, attr
+
+    def do_setattr(self, ctx: Context, ino: int, flags: int, new: Attr) -> tuple[int, Attr]:
+        def fn(tx: KVTxn):
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                return errno.ENOENT, Attr()
+            now = time.time()
+            changed = False
+            if flags & SET_ATTR_MODE:
+                mode = new.mode & 0o7777
+                if ctx.uid != 0 and ctx.uid != attr.uid and ctx.check_permission:
+                    return errno.EPERM, Attr()
+                # non-member setgid clear (POSIX)
+                if ctx.uid != 0 and not ctx.contains_gid(attr.gid) and ctx.check_permission:
+                    mode &= ~0o2000
+                attr.mode = mode
+                changed = True
+            if flags & SET_ATTR_UID and attr.uid != new.uid:
+                attr.uid = new.uid
+                changed = True
+            if flags & SET_ATTR_GID and attr.gid != new.gid:
+                attr.gid = new.gid
+                changed = True
+            if flags & SET_ATTR_ATIME:
+                attr.atime, attr.atimensec = new.atime, new.atimensec
+                changed = True
+            if flags & SET_ATTR_ATIME_NOW:
+                attr.touch_atime(now)
+                changed = True
+            if flags & SET_ATTR_MTIME:
+                attr.mtime, attr.mtimensec = new.mtime, new.mtimensec
+                changed = True
+            if flags & SET_ATTR_MTIME_NOW:
+                attr.touch_mtime(now)
+                changed = True
+            if flags & SET_ATTR_FLAG:
+                attr.flags = new.flags
+                changed = True
+            if changed:
+                attr.touch_ctime(now)
+                self._set_attr(tx, ino, attr)
+            return 0, attr
+
+        return self.client.txn(fn)
+
+    # ---- namespace -------------------------------------------------------
+    def do_lookup(self, parent: int, name: bytes) -> tuple[int, int, Attr]:
+        def fn(tx: KVTxn):
+            typ, ino = self._get_entry(tx, parent, name)
+            if ino == 0:
+                pattr = self._get_attr(tx, parent)
+                if pattr is None:
+                    return errno.ENOENT, 0, Attr()
+                if pattr.typ != TYPE_DIRECTORY:
+                    return errno.ENOTDIR, 0, Attr()
+                return errno.ENOENT, 0, Attr()
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                # dangling entry: report with partial attr (reference tkv.go Lookup)
+                return 0, ino, Attr(typ=typ, full=False)
+            return 0, ino, attr
+
+        return self.client.simple_txn(fn)
+
+    def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path) -> tuple[int, int, Attr]:
+        ino = self.new_inode()
+
+        def fn(tx: KVTxn):
+            pattr = self._get_attr(tx, parent)
+            if pattr is None:
+                return errno.ENOENT, 0, Attr()
+            if pattr.typ != TYPE_DIRECTORY:
+                return errno.ENOTDIR, 0, Attr()
+            if pattr.flags & FLAG_IMMUTABLE:
+                return errno.EPERM, 0, Attr()
+            etyp, _ = self._get_entry(tx, parent, name)
+            if etyp:
+                return errno.EEXIST, 0, Attr()
+            st = self._update_used(tx, _align4k(0) + (4096 if typ == TYPE_DIRECTORY else 0), 1)
+            if st:
+                return st, 0, Attr()
+            now = time.time()
+            attr = Attr(typ=typ, mode=mode & ~cumask & 0o7777, uid=ctx.uid, gid=ctx.gid, rdev=rdev)
+            if typ == TYPE_DIRECTORY:
+                attr.nlink = 2
+                attr.length = 4096
+            elif typ == TYPE_SYMLINK:
+                attr.length = len(path)
+                tx.set(self._symlink_key(ino), path)
+            attr.parent = parent
+            # setgid dir: children inherit gid (and dirs inherit setgid)
+            if pattr.mode & 0o2000:
+                attr.gid = pattr.gid
+                if typ == TYPE_DIRECTORY:
+                    attr.mode |= 0o2000
+            attr.touch_atime(now)
+            attr.touch_mtime(now)
+            self._set_attr(tx, ino, attr)
+            self._set_entry(tx, parent, name, typ, ino)
+            if typ == TYPE_DIRECTORY:
+                pattr.nlink += 1
+            pattr.touch_mtime(now)
+            self._set_attr(tx, parent, pattr)
+            self._update_dirstat(tx, parent, 0, 4096 if typ == TYPE_DIRECTORY else 0, 1)
+            return 0, ino, attr
+
+        return self.client.txn(fn)
+
+    def _trash_entry(self, tx: KVTxn, parent: int, name: bytes, ino: int, typ: int) -> None:
+        """Move a doomed entry under the hourly trash dir
+        (reference base.go trash handling: entries renamed {parent}-{ino}-{name})."""
+        hour = time.strftime("%Y-%m-%d-%H", time.gmtime())
+        hname = hour.encode()
+        htyp, hino = self._get_entry(tx, TRASH_INODE, hname)
+        if hino == 0:
+            hino = self.new_inode()
+            now = time.time()
+            hattr = Attr(typ=TYPE_DIRECTORY, mode=0o555, nlink=2, length=4096, parent=TRASH_INODE)
+            hattr.touch_mtime(now)
+            self._set_attr(tx, hino, hattr)
+            self._set_entry(tx, TRASH_INODE, hname, TYPE_DIRECTORY, hino)
+        tname = f"{parent}-{ino}-".encode() + name
+        self._set_entry(tx, hino, tname[:250], typ, ino)
+        attr = self._get_attr(tx, ino)
+        if attr is not None:
+            attr.parent = hino
+            self._set_attr(tx, ino, attr)
+
+    def do_unlink(self, ctx, parent, name, skip_trash=False) -> int:
+        trash = self.fmt.trash_days > 0 and not skip_trash and parent < TRASH_INODE
+
+        def fn(tx: KVTxn):
+            typ, ino = self._get_entry(tx, parent, name)
+            if ino == 0:
+                return errno.ENOENT
+            if typ == TYPE_DIRECTORY:
+                return errno.EISDIR
+            pattr = self._get_attr(tx, parent)
+            attr = self._get_attr(tx, ino)
+            if pattr is None:
+                return errno.ENOENT
+            if attr is not None and self._sticky_violation(pattr, attr, ctx):
+                return errno.EACCES
+            if attr is not None and attr.flags & (FLAG_IMMUTABLE | FLAG_APPEND):
+                return errno.EPERM
+            now = time.time()
+            tx.delete(self._entry_key(parent, name))
+            pattr.touch_mtime(now)
+            self._set_attr(tx, parent, pattr)
+            if attr is None:  # dangling entry
+                return 0
+            if trash and attr.nlink == 1:
+                self._trash_entry(tx, parent, name, ino, typ)
+                attr.touch_ctime(now)
+                self._set_attr(tx, ino, attr)
+                self._update_dirstat(tx, parent, -attr.length, -_align4k(attr.length), -1)
+                return 0
+            attr.nlink -= 1
+            attr.touch_ctime(now)
+            if attr.parent == 0:
+                # multi-parent tracking: drop one link from this parent
+                pk = self._parent_key(ino, parent)
+                raw_pk = tx.get(pk)
+                cnt = _U32.unpack(raw_pk)[0] if raw_pk else 1
+                if cnt > 1:
+                    tx.set(pk, _U32.pack(cnt - 1))
+                else:
+                    tx.delete(pk)
+            self._update_dirstat(tx, parent, -attr.length, -_align4k(attr.length), -1)
+            if attr.nlink > 0:
+                self._set_attr(tx, ino, attr)
+                return 0
+            # last link gone
+            if typ == TYPE_FILE and self.of.is_open(ino) and self.sid:
+                attr.parent = 0
+                self._set_attr(tx, ino, attr)
+                tx.set(self._sustained_key(self.sid, ino), b"1")
+                self._update_used(tx, -_align4k(attr.length), -1)
+                return 0
+            tx.delete(self._attr_key(ino))
+            if typ == TYPE_FILE and attr.length > 0:
+                tx.set(self._delfile_key(ino, attr.length), _F64.pack(now))
+            elif typ == TYPE_SYMLINK:
+                tx.delete(self._symlink_key(ino))
+            for k in tx.scan_keys(self._ino_key(ino) + b"X"):
+                tx.delete(k)
+            for k in tx.scan_keys(self._ino_key(ino) + b"P"):
+                tx.delete(k)
+            self._update_used(tx, -_align4k(attr.length), -1)
+            return 0
+
+        return self.client.txn(fn)
+
+    def do_rmdir(self, ctx, parent, name, skip_trash=False) -> int:
+        trash = self.fmt.trash_days > 0 and not skip_trash and parent < TRASH_INODE
+
+        def fn(tx: KVTxn):
+            typ, ino = self._get_entry(tx, parent, name)
+            if ino == 0:
+                return errno.ENOENT
+            if typ != TYPE_DIRECTORY:
+                return errno.ENOTDIR
+            if tx.exists(self._ino_key(ino) + b"D"):
+                return errno.ENOTEMPTY
+            pattr = self._get_attr(tx, parent)
+            attr = self._get_attr(tx, ino)
+            if pattr is None:
+                return errno.ENOENT
+            if attr is not None and self._sticky_violation(pattr, attr, ctx):
+                return errno.EACCES
+            now = time.time()
+            tx.delete(self._entry_key(parent, name))
+            pattr.nlink -= 1
+            pattr.touch_mtime(now)
+            self._set_attr(tx, parent, pattr)
+            self._update_dirstat(tx, parent, 0, -4096, -1)
+            if attr is None:
+                return 0
+            if trash:
+                self._trash_entry(tx, parent, name, ino, typ)
+                return 0
+            tx.delete(self._attr_key(ino))
+            tx.delete(self._dirstat_key(ino))
+            tx.delete(self._dirquota_key(ino))
+            for k in tx.scan_keys(self._ino_key(ino) + b"X"):
+                tx.delete(k)
+            self._update_used(tx, -4096, -1)
+            return 0
+
+        return self.client.txn(fn)
+
+    def do_rename(self, ctx, psrc, nsrc, pdst, ndst, flags) -> tuple[int, int, Attr]:
+        if flags & ~(RENAME_NOREPLACE | RENAME_EXCHANGE):
+            return errno.ENOTSUP, 0, Attr()
+
+        def fn(tx: KVTxn):
+            styp, sino = self._get_entry(tx, psrc, nsrc)
+            if sino == 0:
+                return errno.ENOENT, 0, Attr()
+            if psrc == pdst and nsrc == ndst:
+                attr = self._get_attr(tx, sino)
+                return 0, sino, attr or Attr()
+            sattr = self._get_attr(tx, sino)
+            spattr = self._get_attr(tx, psrc)
+            dpattr = self._get_attr(tx, pdst)
+            if spattr is None or dpattr is None or sattr is None:
+                return errno.ENOENT, 0, Attr()
+            if dpattr.typ != TYPE_DIRECTORY:
+                return errno.ENOTDIR, 0, Attr()
+            if self._sticky_violation(spattr, sattr, ctx):
+                return errno.EACCES, 0, Attr()
+            # moving a directory into its own subtree is forbidden
+            if styp == TYPE_DIRECTORY and psrc != pdst:
+                p = pdst
+                while p and p != ROOT_INODE:
+                    if p == sino:
+                        return errno.EINVAL, 0, Attr()
+                    pa = self._get_attr(tx, p)
+                    if pa is None or pa.parent == p:
+                        break
+                    p = pa.parent
+            dtyp, dino = self._get_entry(tx, pdst, ndst)
+            now = time.time()
+            if dino and flags & RENAME_NOREPLACE:
+                return errno.EEXIST, 0, Attr()
+            if flags & RENAME_EXCHANGE:
+                if dino == 0:
+                    return errno.ENOENT, 0, Attr()
+                dattr = self._get_attr(tx, dino)
+                if dattr is None:
+                    return errno.ENOENT, 0, Attr()
+                self._set_entry(tx, psrc, nsrc, dtyp, dino)
+                self._set_entry(tx, pdst, ndst, styp, sino)
+                sattr.parent, dattr.parent = pdst, psrc
+                sattr.touch_ctime(now)
+                dattr.touch_ctime(now)
+                self._set_attr(tx, sino, sattr)
+                self._set_attr(tx, dino, dattr)
+                if psrc != pdst and styp != dtyp:
+                    if styp == TYPE_DIRECTORY:
+                        spattr.nlink -= 1
+                        dpattr.nlink += 1
+                    if dtyp == TYPE_DIRECTORY:
+                        spattr.nlink += 1
+                        dpattr.nlink -= 1
+                spattr.touch_mtime(now)
+                self._set_attr(tx, psrc, spattr)
+                if psrc != pdst:
+                    dpattr.touch_mtime(now)
+                    self._set_attr(tx, pdst, dpattr)
+                return 0, sino, sattr
+            if dino:
+                dattr = self._get_attr(tx, dino)
+                if dtyp == TYPE_DIRECTORY:
+                    if styp != TYPE_DIRECTORY:
+                        return errno.EISDIR, 0, Attr()
+                    if tx.exists(self._ino_key(dino) + b"D"):
+                        return errno.ENOTEMPTY, 0, Attr()
+                elif styp == TYPE_DIRECTORY:
+                    return errno.ENOTDIR, 0, Attr()
+                if dattr is not None and self._sticky_violation(dpattr, dattr, ctx):
+                    return errno.EACCES, 0, Attr()
+                # replace: dst loses its entry (goes to trash / delfiles)
+                st = self._free_entry(tx, pdst, ndst, dtyp, dino, dattr, now)
+                if st:
+                    return st, 0, Attr()
+            tx.delete(self._entry_key(psrc, nsrc))
+            self._set_entry(tx, pdst, ndst, styp, sino)
+            if sattr.parent:
+                sattr.parent = pdst
+            else:
+                tx.delete(self._parent_key(sino, psrc))
+                pk = self._parent_key(sino, pdst)
+                old = tx.get(pk)
+                tx.set(pk, _U32.pack((_U32.unpack(old)[0] if old else 0) + 1))
+            sattr.touch_ctime(now)
+            self._set_attr(tx, sino, sattr)
+            if styp == TYPE_DIRECTORY and psrc != pdst:
+                spattr.nlink -= 1
+                dpattr.nlink += 1
+            spattr.touch_mtime(now)
+            self._set_attr(tx, psrc, spattr)
+            if psrc != pdst:
+                dpattr.touch_mtime(now)
+                self._set_attr(tx, pdst, dpattr)
+            dsz = sattr.length if styp == TYPE_FILE else 0
+            self._update_dirstat(tx, psrc, -dsz, -(_align4k(dsz) if styp == TYPE_FILE else 4096), -1)
+            self._update_dirstat(tx, pdst, dsz, _align4k(dsz) if styp == TYPE_FILE else 4096, 1)
+            return 0, sino, sattr
+
+        return self.client.txn(fn)
+
+    def _free_entry(self, tx: KVTxn, parent: int, name: bytes, typ: int, ino: int, attr, now) -> int:
+        """Drop the entry at (parent, name) whose inode is being replaced."""
+        trash = self.fmt.trash_days > 0 and parent < TRASH_INODE
+        tx.delete(self._entry_key(parent, name))
+        if attr is None:
+            return 0
+        if trash and (typ == TYPE_DIRECTORY or attr.nlink == 1):
+            self._trash_entry(tx, parent, name, ino, typ)
+            self._update_dirstat(
+                tx, parent, -(attr.length if typ == TYPE_FILE else 0),
+                -(_align4k(attr.length) if typ == TYPE_FILE else 4096), -1,
+            )
+            return 0
+        if typ == TYPE_DIRECTORY:
+            tx.delete(self._attr_key(ino))
+            tx.delete(self._dirstat_key(ino))
+            self._update_used(tx, -4096, -1)
+            self._update_dirstat(tx, parent, 0, -4096, -1)
+            return 0
+        attr.nlink -= 1
+        attr.touch_ctime(now)
+        self._update_dirstat(tx, parent, -attr.length, -_align4k(attr.length), -1)
+        if attr.nlink > 0:
+            self._set_attr(tx, ino, attr)
+        else:
+            if typ == TYPE_FILE and self.of.is_open(ino) and self.sid:
+                attr.parent = 0
+                self._set_attr(tx, ino, attr)
+                tx.set(self._sustained_key(self.sid, ino), b"1")
+            else:
+                tx.delete(self._attr_key(ino))
+                if typ == TYPE_FILE and attr.length > 0:
+                    tx.set(self._delfile_key(ino, attr.length), _F64.pack(now))
+                elif typ == TYPE_SYMLINK:
+                    tx.delete(self._symlink_key(ino))
+            self._update_used(tx, -_align4k(attr.length), -1)
+        return 0
+
+    def do_link(self, ctx, ino, parent, name) -> tuple[int, Attr]:
+        def fn(tx: KVTxn):
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                return errno.ENOENT, Attr()
+            if attr.typ == TYPE_DIRECTORY:
+                return errno.EPERM, Attr()
+            if attr.flags & FLAG_IMMUTABLE:
+                return errno.EPERM, Attr()
+            etyp, _ = self._get_entry(tx, parent, name)
+            if etyp:
+                return errno.EEXIST, Attr()
+            pattr = self._get_attr(tx, parent)
+            if pattr is None:
+                return errno.ENOENT, Attr()
+            if pattr.typ != TYPE_DIRECTORY:
+                return errno.ENOTDIR, Attr()
+            now = time.time()
+            if attr.parent and attr.parent != parent:
+                # becomes multi-parent: track parents out-of-band
+                pk_old = self._parent_key(ino, attr.parent)
+                tx.set(pk_old, _U32.pack(1))
+                attr.parent = 0
+            if attr.parent == 0:
+                pk = self._parent_key(ino, parent)
+                old = tx.get(pk)
+                tx.set(pk, _U32.pack((_U32.unpack(old)[0] if old else 0) + 1))
+            attr.nlink += 1
+            attr.touch_ctime(now)
+            self._set_attr(tx, ino, attr)
+            self._set_entry(tx, parent, name, attr.typ, ino)
+            pattr.touch_mtime(now)
+            self._set_attr(tx, parent, pattr)
+            self._update_dirstat(tx, parent, attr.length, _align4k(attr.length), 1)
+            return 0, attr
+
+        return self.client.txn(fn)
+
+    def do_readdir(self, ctx, ino, want_attr) -> tuple[int, list[Entry]]:
+        def fn(tx: KVTxn):
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                return errno.ENOENT, []
+            if attr.typ != TYPE_DIRECTORY:
+                return errno.ENOTDIR, []
+            out = []
+            for name, typ, cino in self._scan_entries(tx, ino):
+                if want_attr:
+                    cattr = self._get_attr(tx, cino) or Attr(typ=typ, full=False)
+                else:
+                    cattr = Attr(typ=typ, full=False)
+                out.append(Entry(inode=cino, name=name, attr=cattr))
+            return 0, out
+
+        return self.client.simple_txn(fn)
+
+    def do_readlink(self, ino) -> tuple[int, bytes]:
+        raw = self.client.simple_txn(lambda tx: tx.get(self._symlink_key(ino)))
+        if raw is None:
+            return errno.EINVAL, b""
+        return 0, raw
+
+    def get_parents(self, ino: int) -> dict[int, int]:
+        """parent-ino -> link count (reference base.go GetParents)."""
+        st, attr = self.do_getattr(ino)
+        if st:
+            return {}
+        if attr.parent:
+            return {attr.parent: 1}
+        prefix = self._ino_key(ino) + b"P"
+        return {
+            int.from_bytes(k[len(prefix):], "big"): _U32.unpack(v)[0]
+            for k, v in self.client.scan(prefix, next_key(prefix))
+        }
+
+    # ---- file data -------------------------------------------------------
+    def do_read_chunk(self, ino, indx) -> tuple[int, list[Slice]]:
+        raw = self.client.simple_txn(lambda tx: tx.get(self._chunk_key(ino, indx)))
+        if raw is None:
+            return 0, []
+        return 0, Slice.decode_list(raw)
+
+    def do_write_chunk(self, ino, indx, pos, slc: Slice, length_hint: int, incref: bool = False) -> int:
+        def fn(tx: KVTxn):
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                return errno.ENOENT
+            if attr.typ != TYPE_FILE:
+                return errno.EPERM
+            if incref and slc.id:
+                # sharing an existing slice (copy_file_range/clone): bump refs
+                self._incref_slice(tx, slc.id, slc.size)
+            now = time.time()
+            if length_hint > attr.length:
+                delta = _align4k(length_hint) - _align4k(attr.length)
+                if delta > 0:
+                    st = self._update_used(tx, delta, 0)
+                    if st:
+                        return st
+                if attr.parent:
+                    self._update_dirstat(tx, attr.parent, length_hint - attr.length, delta, 0)
+                attr.length = length_hint
+            attr.touch_mtime(now)
+            self._set_attr(tx, ino, attr)
+            data = tx.append(self._chunk_key(ino, indx), slc.encode())
+            if len(data) // Slice.ENCODED_LEN > 100:
+                self._notify(interface.COMPACT_CHUNK, ino, indx)
+            return 0
+
+        return self.client.txn(fn)
+
+    def do_truncate(self, ctx, ino, length) -> tuple[int, Attr]:
+        def fn(tx: KVTxn):
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                return errno.ENOENT, Attr()
+            if attr.typ != TYPE_FILE:
+                return errno.EPERM, Attr()
+            if attr.flags & (FLAG_IMMUTABLE | FLAG_APPEND):
+                return errno.EPERM, Attr()
+            old = attr.length
+            delta = _align4k(length) - _align4k(old)
+            if delta > 0:
+                st = self._update_used(tx, delta, 0)
+                if st:
+                    return st, Attr()
+            elif delta < 0:
+                self._update_used(tx, delta, 0)
+            if attr.parent:
+                self._update_dirstat(tx, attr.parent, length - old, delta, 0)
+            attr.length = length
+            attr.touch_mtime(time.time())
+            self._set_attr(tx, ino, attr)
+            if length < old:
+                # drop whole chunks beyond the new end
+                first_dead = (length + CHUNK_SIZE - 1) // CHUNK_SIZE
+                last = old // CHUNK_SIZE
+                for i in range(first_dead, last + 1):
+                    key = self._chunk_key(ino, i)
+                    raw = tx.get(key)
+                    if raw:
+                        for s in Slice.decode_list(raw):
+                            if s.id:
+                                self._decref_slice(tx, s.id, s.size)
+                        tx.delete(key)
+                # boundary chunk: shadow the truncated tail with a hole so a
+                # later grow reads zeros, not resurrected data (POSIX)
+                bpos = length % CHUNK_SIZE
+                if bpos:
+                    bindx = length // CHUNK_SIZE
+                    tail = min(old - bindx * CHUNK_SIZE, CHUNK_SIZE) - bpos
+                    if tail > 0 and tx.get(self._chunk_key(ino, bindx)):
+                        hole = Slice(pos=bpos, id=0, size=tail, off=0, len=tail)
+                        tx.append(self._chunk_key(ino, bindx), hole.encode())
+            return 0, attr
+
+        return self.client.txn(fn)
+
+    def do_fallocate(self, ctx, ino, mode, off, size) -> int:
+        FALLOC_KEEP_SIZE, FALLOC_PUNCH_HOLE, FALLOC_ZERO_RANGE = 0x1, 0x2, 0x10
+
+        def fn(tx: KVTxn):
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                return errno.ENOENT
+            if attr.typ != TYPE_FILE:
+                return errno.EPERM
+            length = attr.length
+            if not mode & FALLOC_KEEP_SIZE and off + size > length:
+                delta = _align4k(off + size) - _align4k(length)
+                if delta > 0:
+                    st = self._update_used(tx, delta, 0)
+                    if st:
+                        return st
+                if attr.parent:
+                    self._update_dirstat(tx, attr.parent, off + size - length, max(delta, 0), 0)
+                attr.length = off + size
+            if mode & (FALLOC_PUNCH_HOLE | FALLOC_ZERO_RANGE):
+                end = min(off + size, attr.length)
+                cur = off
+                while cur < end:
+                    indx = cur // CHUNK_SIZE
+                    pos = cur % CHUNK_SIZE
+                    n = min(CHUNK_SIZE - pos, end - cur)
+                    hole = Slice(pos=pos, id=0, size=n, off=0, len=n)
+                    tx.append(self._chunk_key(ino, indx), hole.encode())
+                    cur += n
+            attr.touch_mtime(time.time())
+            self._set_attr(tx, ino, attr)
+            return 0
+
+        return self.client.txn(fn)
+
+    def _incref_slice(self, tx: KVTxn, sid: int, size: int) -> None:
+        """Add one reference to a stored slice (reference tkv.go sliceRef:
+        stored value == refcount-1, absent == 1)."""
+        key = self._sliceref_key(sid, size)
+        raw = tx.get(key)
+        cnt = _I64.unpack(raw)[0] if raw else 0
+        tx.set(key, _I64.pack(cnt + 1))
+
+    def _decref_slice(self, tx: KVTxn, sid: int, size: int) -> None:
+        """Decrement a slice refcount; schedule block deletion at zero
+        (reference tkv.go sliceRef: stored value == refcount-1)."""
+        key = self._sliceref_key(sid, size)
+        raw = tx.get(key)
+        cnt = _I64.unpack(raw)[0] if raw else 0
+        cnt -= 1
+        if cnt < 0:
+            tx.delete(key)
+            self._notify(interface.DELETE_SLICE, sid, size)
+        else:
+            tx.set(key, _I64.pack(cnt))
+
+    def do_find_deleted_files(self, limit: int) -> dict[int, int]:
+        out: dict[int, int] = {}
+        for k, _ in self.client.scan(b"D", next_key(b"D")):
+            if len(k) == 17:
+                out[int.from_bytes(k[1:9], "big")] = int.from_bytes(k[9:17], "big")
+                if len(out) >= limit:
+                    break
+        return out
+
+    def do_delete_file_data(self, ino: int, length: int) -> None:
+        """Reclaim all slices of a deleted file (reference base.go
+        doDeleteFileData): decref every slice, notify DELETE_SLICE at zero."""
+        prefix = self._ino_key(ino) + b"C"
+        chunks = [k for k, _ in self.client.scan(prefix, next_key(prefix))]
+        for key in chunks:
+
+            def fn(tx: KVTxn, key=key):
+                raw = tx.get(key)
+                if raw:
+                    for s in Slice.decode_list(raw):
+                        if s.id:
+                            self._decref_slice(tx, s.id, s.size)
+                    tx.delete(key)
+                return 0
+
+            self.client.txn(fn)
+        self.client.txn(lambda tx: tx.delete(self._delfile_key(ino, length)))
+
+    def do_list_slices(self) -> dict[int, list[Slice]]:
+        out: dict[int, list[Slice]] = {}
+        for k, v in self.client.scan(b"A", next_key(b"A")):
+            if len(k) >= 13 and k[9:10] == b"C":
+                ino = int.from_bytes(k[1:9], "big")
+                out.setdefault(ino, []).extend(
+                    s for s in Slice.decode_list(v) if s.id
+                )
+        return out
+
+    def compact_chunk(self, ino: int, indx: int, new_id: int, new_size: int, n_old: int) -> int:
+        """Atomically replace the first n_old slice records with one merged
+        slice (reference base.go:2009 compactChunk). Fails with EINVAL if the
+        chunk changed concurrently (caller re-reads and retries)."""
+
+        def fn(tx: KVTxn):
+            key = self._chunk_key(ino, indx)
+            raw = tx.get(key)
+            if raw is None or len(raw) // Slice.ENCODED_LEN < n_old:
+                return errno.EINVAL
+            olds = Slice.decode_list(raw[: n_old * Slice.ENCODED_LEN])
+            rest = raw[n_old * Slice.ENCODED_LEN:]
+            view = build_slice(olds)
+            total = max((s.pos + s.len for s in view), default=0)
+            merged = Slice(pos=0, id=new_id, size=new_size, off=0, len=total)
+            tx.set(key, merged.encode() + rest)
+            for s in olds:
+                if s.id:
+                    self._decref_slice(tx, s.id, s.size)
+            return 0
+
+        return self.client.txn(fn)
+
+    # ---- xattr -----------------------------------------------------------
+    def do_getxattr(self, ino, name) -> tuple[int, bytes]:
+        raw = self.client.simple_txn(lambda tx: tx.get(self._xattr_key(ino, name)))
+        if raw is None:
+            return errno.ENODATA, b""
+        return 0, raw
+
+    def do_setxattr(self, ino, name, value, flags) -> int:
+        XATTR_CREATE, XATTR_REPLACE = 1, 2
+
+        def fn(tx: KVTxn):
+            if self._get_attr(tx, ino) is None:
+                return errno.ENOENT
+            key = self._xattr_key(ino, name)
+            old = tx.get(key)
+            if flags & XATTR_CREATE and old is not None:
+                return errno.EEXIST
+            if flags & XATTR_REPLACE and old is None:
+                return errno.ENODATA
+            tx.set(key, value)
+            return 0
+
+        return self.client.txn(fn)
+
+    def do_listxattr(self, ino) -> tuple[int, list[bytes]]:
+        def fn(tx: KVTxn):
+            if self._get_attr(tx, ino) is None:
+                return errno.ENOENT, []
+            prefix = self._ino_key(ino) + b"X"
+            return 0, [k[len(prefix):] for k, _ in tx.scan(prefix, next_key(prefix), keys_only=True)]
+
+        return self.client.simple_txn(fn)
+
+    def do_removexattr(self, ino, name) -> int:
+        def fn(tx: KVTxn):
+            key = self._xattr_key(ino, name)
+            if tx.get(key) is None:
+                return errno.ENODATA
+            tx.delete(key)
+            return 0
+
+        return self.client.txn(fn)
+
+    # ---- locks (reference redis_lock.go / tkv_lock.go semantics) ---------
+    F_UNLCK, F_RDLCK, F_WRLCK = 2, 0, 1
+
+    def flock(self, ctx, ino: int, owner: int, ltype: str) -> int:
+        """BSD flock: ltype in {"R","W","U"} (reference interface.go Flock)."""
+
+        def fn(tx: KVTxn):
+            key = self._flock_key(ino)
+            raw = tx.get(key)
+            table: dict[str, str] = json.loads(raw) if raw else {}
+            me = f"{self.sid}/{owner:x}"
+            if ltype == "U":
+                table.pop(me, None)
+            elif ltype == "R":
+                if any(t == "W" and o != me for o, t in table.items()):
+                    return errno.EAGAIN
+                table[me] = "R"
+            elif ltype == "W":
+                if any(o != me for o in table):
+                    return errno.EAGAIN
+                table[me] = "W"
+            else:
+                return errno.EINVAL
+            if table:
+                tx.set(key, json.dumps(table).encode())
+            else:
+                tx.delete(key)
+            return 0
+
+        return self.client.txn(fn)
+
+    def setlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int, pid: int = 0) -> int:
+        """POSIX record lock set/unset; non-blocking (reference Setlk)."""
+
+        def fn(tx: KVTxn):
+            key = self._plock_key(ino)
+            raw = tx.get(key)
+            locks: list = json.loads(raw) if raw else []
+            me = [self.sid, owner]
+            if ltype == self.F_UNLCK:
+                locks = [
+                    l for l in locks
+                    if not (l[0] == me[0] and l[1] == me[1] and l[3] < end and l[4] > start)
+                ] + [
+                    # keep non-overlapping remains of own locks
+                    part
+                    for l in locks
+                    if l[0] == me[0] and l[1] == me[1] and l[3] < end and l[4] > start
+                    for part in (
+                        ([[l[0], l[1], l[2], l[3], start, l[5]]] if l[3] < start else [])
+                        + ([[l[0], l[1], l[2], end, l[4], l[5]]] if l[4] > end else [])
+                    )
+                ]
+            else:
+                for l in locks:
+                    if (l[0] != me[0] or l[1] != me[1]) and l[3] < end and l[4] > start:
+                        if ltype == self.F_WRLCK or l[2] == self.F_WRLCK:
+                            return errno.EAGAIN
+                locks = [
+                    l for l in locks
+                    if not (l[0] == me[0] and l[1] == me[1] and start <= l[3] and l[4] <= end)
+                ]
+                locks.append([me[0], me[1], ltype, start, end, pid])
+            if locks:
+                tx.set(key, json.dumps(locks).encode())
+            else:
+                tx.delete(key)
+            return 0
+
+        return self.client.txn(fn)
+
+    def getlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int) -> tuple[int, int, int, int, int]:
+        """Returns (errno, ltype, start, end, pid); F_UNLCK if free."""
+
+        def fn(tx: KVTxn):
+            raw = tx.get(self._plock_key(ino))
+            locks: list = json.loads(raw) if raw else []
+            for l in locks:
+                if (l[0] != self.sid or l[1] != owner) and l[3] < end and l[4] > start:
+                    if ltype == self.F_WRLCK or l[2] == self.F_WRLCK:
+                        return 0, l[2], l[3], l[4], l[5]
+            return 0, self.F_UNLCK, 0, 0, 0
+
+        return self.client.simple_txn(fn)
+
+    # ---- admin -----------------------------------------------------------
+    def do_statfs(self) -> tuple[int, int, int, int]:
+        def fn(tx: KVTxn):
+            used = self._counter_get(tx, "usedSpace")
+            inodes = self._counter_get(tx, "totalInodes")
+            return used, inodes
+
+        used, iused = self.client.simple_txn(fn)
+        used = max(used, 0)
+        iused = max(iused, 0)
+        total = self.fmt.capacity or (1 << 50)
+        iavail = (self.fmt.inodes - iused) if self.fmt.inodes else (10 << 20)
+        return total, max(total - used, 0), iused, max(iavail, 0)
+
+    def cleanup_trash_before(self, ts: float) -> int:
+        """Purge trash subdirectories older than `ts`
+        (reference base.go:2281 CleanupTrashBefore)."""
+        removed = 0
+        st, entries = self.do_readdir(Context(check_permission=False), TRASH_INODE, False)
+        if st:
+            return 0
+        for e in entries:
+            if e.name in (b".", b".."):
+                continue
+            try:
+                t = calendar.timegm(time.strptime(e.name.decode(), "%Y-%m-%d-%H"))
+            except ValueError:
+                continue
+            if t < ts:
+                st2, n = self.remove_recursive(
+                    Context(check_permission=False), TRASH_INODE, e.name, skip_trash=True
+                )
+                removed += n
+        return removed
+
+    def scan_deleted_objects(self) -> tuple[dict[int, int], int]:
+        """(pending delfiles, trash entry count) for gc reporting
+        (reference base.go:2402 ScanDeletedObject)."""
+        delfiles = self.do_find_deleted_files(1 << 30)
+        st, s = self.summary(Context(check_permission=False), TRASH_INODE)
+        return delfiles, (s.files if st == 0 else 0)
+
+
+def _factory(scheme: str, addr: str) -> KVMeta:
+    client = new_tkv_client(scheme, addr)
+    return KVMeta(client, f"{scheme}://{addr}")
+
+
+interface.register("memkv", _factory)
+interface.register("mem", _factory)
+interface.register("sqlite3", _factory)
+interface.register("sqlite", _factory)

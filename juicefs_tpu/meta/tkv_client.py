@@ -1,0 +1,332 @@
+"""Ordered-KV transaction clients backing the KV meta engine
+(reference: pkg/meta/tkv.go `tkvClient`/`kvTxn` interfaces, tkv_mem.go:272).
+
+Engines provided here:
+    memkv://      in-process ordered KV (hermetic tests; reference tkv_mem.go)
+    sqlite3://    single-file durable KV over sqlite (single-writer txns)
+
+The transaction model is the same as the reference: `txn(fn)` runs `fn(tx)`
+with snapshot reads + buffered writes and commits atomically, retrying on
+conflict. Both local engines serialize writers, so retries only matter for
+future networked engines (TiKV/etcd) which plug in behind the same ABC.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import sqlite3
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from ..utils import get_logger
+
+logger = get_logger("meta.tkv")
+
+
+class KVTxn:
+    """One transaction. Reads see the snapshot plus this txn's own writes."""
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def gets(self, *keys: bytes) -> list[Optional[bytes]]:
+        return [self.get(k) for k in keys]
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def append(self, key: bytes, value: bytes) -> bytes:
+        old = self.get(key) or b""
+        new = old + value
+        self.set(key, new)
+        return new
+
+    def incr_by(self, key: bytes, delta: int) -> int:
+        old = self.get(key)
+        v = int.from_bytes(old, "big", signed=True) if old else 0
+        v += delta
+        self.set(key, v.to_bytes(8, "big", signed=True))
+        return v
+
+    def scan(
+        self,
+        begin: bytes,
+        end: bytes,
+        keys_only: bool = False,
+        limit: int = -1,
+    ) -> Iterator[tuple[bytes, bytes]]:
+        """Iterate [begin, end) in key order."""
+        raise NotImplementedError
+
+    def scan_keys(self, prefix: bytes) -> list[bytes]:
+        return [k for k, _ in self.scan(prefix, next_key(prefix), keys_only=True)]
+
+    def scan_values(self, prefix: bytes) -> dict[bytes, bytes]:
+        return dict(self.scan(prefix, next_key(prefix)))
+
+    def exists(self, prefix: bytes) -> bool:
+        for _ in self.scan(prefix, next_key(prefix), keys_only=True, limit=1):
+            return True
+        return False
+
+
+class TKVClient:
+    """Engine handle (reference tkv.go tkvClient)."""
+
+    name = "tkv"
+
+    def txn(self, fn: Callable[[KVTxn], object], retries: int = 50) -> object:
+        raise NotImplementedError
+
+    def simple_txn(self, fn: Callable[[KVTxn], object]) -> object:
+        """Read-mostly transaction; same semantics, may skip write locking."""
+        return self.txn(fn)
+
+    def scan(self, begin: bytes, end: bytes) -> Iterator[tuple[bytes, bytes]]:
+        """Non-transactional bulk scan for gc/fsck/dump sweeps."""
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def gc(self) -> None:
+        pass
+
+
+def next_key(prefix: bytes) -> bytes:
+    """Smallest key strictly greater than every key with this prefix."""
+    b = bytearray(prefix)
+    i = len(b) - 1
+    while i >= 0:
+        if b[i] < 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1])
+        i -= 1
+    return b"\xff" * (len(prefix) + 1)
+
+
+class ConflictError(Exception):
+    """Optimistic transaction conflict; caller retries."""
+
+
+# --------------------------------------------------------------------------
+# In-memory engine (reference pkg/meta/tkv_mem.go:272)
+# --------------------------------------------------------------------------
+
+
+class _MemTxn(KVTxn):
+    def __init__(self, store: "MemKV"):
+        self._store = store
+        self._writes: dict[bytes, Optional[bytes]] = {}
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if key in self._writes:
+            return self._writes[key]
+        return self._store._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._writes[key] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        self._writes[key] = None
+
+    def scan(self, begin, end, keys_only=False, limit=-1):
+        data = self._store._data
+        keys = self._store._keys
+        lo = bisect.bisect_left(keys, begin)
+        hi = bisect.bisect_left(keys, end)
+        merged: dict[bytes, Optional[bytes]] = {}
+        for k in keys[lo:hi]:
+            merged[k] = data[k]
+        for k, v in self._writes.items():
+            if begin <= k < end:
+                merged[k] = v
+        n = 0
+        for k in sorted(merged):
+            v = merged[k]
+            if v is None:
+                continue
+            yield (k, b"" if keys_only else v)
+            n += 1
+            if limit >= 0 and n >= limit:
+                return
+
+
+class MemKV(TKVClient):
+    """Serialized in-process ordered KV; the hermetic test engine."""
+
+    name = "memkv"
+
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []  # sorted index of _data keys
+        self._lock = threading.RLock()
+        self._local = threading.local()
+
+    def txn(self, fn, retries: int = 50):
+        # nested txn: join the enclosing transaction (single atomic commit)
+        active = getattr(self._local, "tx", None)
+        if active is not None:
+            return fn(active)
+        with self._lock:
+            tx = _MemTxn(self)
+            self._local.tx = tx
+            try:
+                result = fn(tx)
+            finally:
+                self._local.tx = None
+            for k, v in tx._writes.items():
+                if v is None:
+                    if k in self._data:
+                        del self._data[k]
+                        i = bisect.bisect_left(self._keys, k)
+                        if i < len(self._keys) and self._keys[i] == k:
+                            self._keys.pop(i)
+                else:
+                    if k not in self._data:
+                        bisect.insort(self._keys, k)
+                    self._data[k] = v
+            return result
+
+    def scan(self, begin, end):
+        with self._lock:
+            lo = bisect.bisect_left(self._keys, begin)
+            hi = bisect.bisect_left(self._keys, end)
+            snapshot = [(k, self._data[k]) for k in self._keys[lo:hi]]
+        yield from snapshot
+
+    def reset(self):
+        with self._lock:
+            self._data.clear()
+            self._keys.clear()
+
+
+# --------------------------------------------------------------------------
+# SQLite-backed ordered KV
+# --------------------------------------------------------------------------
+
+
+class _SqliteTxn(KVTxn):
+    def __init__(self, conn: sqlite3.Connection):
+        self._conn = conn
+
+    def get(self, key):
+        row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return bytes(row[0]) if row else None
+
+    def set(self, key, value):
+        self._conn.execute(
+            "INSERT INTO kv(k, v) VALUES(?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+            (key, bytes(value)),
+        )
+
+    def delete(self, key):
+        self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+
+    def scan(self, begin, end, keys_only=False, limit=-1):
+        sql = "SELECT k{} FROM kv WHERE k >= ? AND k < ? ORDER BY k".format(
+            "" if keys_only else ", v"
+        )
+        if limit >= 0:
+            sql += f" LIMIT {int(limit)}"
+        for row in self._conn.execute(sql, (begin, end)):
+            if keys_only:
+                yield (bytes(row[0]), b"")
+            else:
+                yield (bytes(row[0]), bytes(row[1]))
+
+
+class SqliteKV(TKVClient):
+    """Durable single-host engine over one sqlite file (WAL mode).
+
+    sqlite is single-writer, so transactions take a process-wide lock plus
+    BEGIN IMMEDIATE; cross-process writers serialize on the sqlite lock with
+    a busy timeout.
+    """
+
+    name = "sqlite3"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._local = threading.local()
+        self._wlock = threading.RLock()
+        conn = self._get_conn()
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID"
+        )
+        conn.commit()
+
+    def _get_conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30.0, isolation_level=None)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    def txn(self, fn, retries: int = 50):
+        conn = self._get_conn()
+        # nested txn: join the enclosing transaction (single atomic commit)
+        if getattr(self._local, "in_txn", False):
+            return fn(_SqliteTxn(conn))
+        last: Exception | None = None
+        for attempt in range(retries):
+            with self._wlock:
+                try:
+                    conn.execute("BEGIN IMMEDIATE")
+                    self._local.in_txn = True
+                    result = fn(_SqliteTxn(conn))
+                    conn.execute("COMMIT")
+                    return result
+                except sqlite3.OperationalError as e:
+                    conn.execute("ROLLBACK")
+                    last = e
+                    time.sleep(min(0.001 * (1 << min(attempt, 8)), 0.1))
+                except BaseException:
+                    try:
+                        conn.execute("ROLLBACK")
+                    except sqlite3.OperationalError:
+                        pass
+                    raise
+                finally:
+                    self._local.in_txn = False
+        raise last  # type: ignore[misc]
+
+    def scan(self, begin, end):
+        conn = self._get_conn()
+        for row in conn.execute(
+            "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k", (begin, end)
+        ):
+            yield (bytes(row[0]), bytes(row[1]))
+
+    def reset(self):
+        conn = self._get_conn()
+        with self._wlock:
+            conn.execute("DELETE FROM kv")
+            conn.commit()
+
+    def close(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+def new_tkv_client(driver: str, addr: str) -> TKVClient:
+    """Open an ordered-KV engine (reference tkv.go newTkvClient)."""
+    if driver in ("memkv", "mem"):
+        return MemKV()
+    if driver in ("sqlite3", "sqlite"):
+        if addr and addr != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(addr)) or ".", exist_ok=True)
+        return SqliteKV(addr or ":memory:")
+    raise ValueError(f"unknown tkv driver: {driver}")

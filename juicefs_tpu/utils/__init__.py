@@ -1,0 +1,130 @@
+"""Cross-cutting utilities (reference: pkg/utils).
+
+Provides the logger, a little-endian-free binary Buffer codec used by the
+meta key/value schema (reference pkg/utils/buffer.go:25), and clock helpers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import time
+
+_LOG_FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
+_configured = False
+_lock = threading.Lock()
+
+
+def get_logger(name: str = "juicefs") -> logging.Logger:
+    """Process-wide logger (reference pkg/utils/logger.go)."""
+    global _configured
+    with _lock:
+        if not _configured:
+            level = os.environ.get("JFS_LOG_LEVEL", "WARNING").upper()
+            logging.basicConfig(format=_LOG_FORMAT, level=level)
+            _configured = True
+    return logging.getLogger(name)
+
+
+class Buffer:
+    """Big-endian binary writer/reader (reference pkg/utils/buffer.go:25).
+
+    The meta engines encode Attr records and KV keys big-endian so that
+    byte-wise key order equals numeric order (reference pkg/meta/tkv.go:165).
+    """
+
+    __slots__ = ("_b", "_off")
+
+    def __init__(self, data: bytes = b""):
+        self._b = bytearray(data)
+        self._off = 0
+
+    # -- writing ----------------------------------------------------------
+    def put8(self, v: int) -> "Buffer":
+        self._b += struct.pack(">B", v & 0xFF)
+        return self
+
+    def put16(self, v: int) -> "Buffer":
+        self._b += struct.pack(">H", v & 0xFFFF)
+        return self
+
+    def put32(self, v: int) -> "Buffer":
+        self._b += struct.pack(">I", v & 0xFFFFFFFF)
+        return self
+
+    def put64(self, v: int) -> "Buffer":
+        self._b += struct.pack(">Q", v & 0xFFFFFFFFFFFFFFFF)
+        return self
+
+    def put(self, data: bytes) -> "Buffer":
+        self._b += data
+        return self
+
+    # -- reading ----------------------------------------------------------
+    def get8(self) -> int:
+        v = self._b[self._off]
+        self._off += 1
+        return v
+
+    def get16(self) -> int:
+        (v,) = struct.unpack_from(">H", self._b, self._off)
+        self._off += 2
+        return v
+
+    def get32(self) -> int:
+        (v,) = struct.unpack_from(">I", self._b, self._off)
+        self._off += 4
+        return v
+
+    def get64(self) -> int:
+        (v,) = struct.unpack_from(">Q", self._b, self._off)
+        self._off += 8
+        return v
+
+    def get(self, n: int) -> bytes:
+        v = bytes(self._b[self._off : self._off + n])
+        self._off += n
+        return v
+
+    def has_more(self) -> bool:
+        return self._off < len(self._b)
+
+    def remaining(self) -> int:
+        return len(self._b) - self._off
+
+    def bytes(self) -> bytes:
+        return bytes(self._b)
+
+
+def now() -> float:
+    return time.time()
+
+
+def now_ns() -> int:
+    return time.time_ns()
+
+
+def align_up(n: int, a: int) -> int:
+    return (n + a - 1) // a * a
+
+
+class Cond:
+    """Condition with wait-timeout helper (reference pkg/utils/cond.go)."""
+
+    def __init__(self, lock: threading.Lock | None = None):
+        self._cond = threading.Condition(lock or threading.Lock())
+
+    def __enter__(self):
+        self._cond.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        return self._cond.__exit__(*a)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._cond.wait(timeout)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()

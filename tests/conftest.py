@@ -1,0 +1,15 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh so sharding
+paths are exercised hermetically (multi-chip TPU hardware is validated
+separately by __graft_entry__.dryrun_multichip)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,518 @@
+"""Meta engine tests, run against every KV engine
+(mirrors reference pkg/meta/base_test.go's all-engine matrix)."""
+
+import errno
+import os
+import stat
+
+import pytest
+
+from juicefs_tpu.meta import (
+    Attr,
+    Format,
+    Meta,
+    Slice,
+    new_client,
+    CHUNK_SIZE,
+    ROOT_INODE,
+    TYPE_DIRECTORY,
+    TYPE_FILE,
+    TYPE_SYMLINK,
+)
+from juicefs_tpu.meta import interface as meta_interface
+from juicefs_tpu.meta.context import Context
+from juicefs_tpu.meta.slice import build_slice
+from juicefs_tpu.meta.types import (
+    RENAME_EXCHANGE,
+    RENAME_NOREPLACE,
+    SET_ATTR_GID,
+    SET_ATTR_MODE,
+    SET_ATTR_UID,
+    TRASH_INODE,
+)
+
+CTX = Context(uid=0, gid=0)
+USER = Context(uid=1000, gid=1000, gids=(1000,))
+
+
+@pytest.fixture(params=["memkv", "sqlite3"])
+def m(request, tmp_path):
+    if request.param == "memkv":
+        uri = "memkv://test"
+    else:
+        uri = f"sqlite3://{tmp_path}/meta.db"
+    client = new_client(uri)
+    client.init(Format(name="test", trash_days=0), force=True)
+    client.load()
+    client.new_session()
+    yield client
+    client.close_session()
+
+
+def test_format_roundtrip(tmp_path):
+    c = new_client(f"sqlite3://{tmp_path}/f.db")
+    fmt = Format(name="vol1", block_size=4096, compression="lz4", trash_days=3)
+    c.init(fmt)
+    c2 = new_client(f"sqlite3://{tmp_path}/f.db")
+    loaded = c2.load()
+    assert loaded.name == "vol1"
+    assert loaded.compression == "lz4"
+    assert loaded.trash_days == 3
+    # re-init with different name without force fails
+    with pytest.raises(RuntimeError):
+        c2.init(Format(name="other"))
+
+
+def test_mkdir_lookup_rmdir(m):
+    st, ino, attr = m.mkdir(CTX, ROOT_INODE, b"d1", 0o755)
+    assert st == 0 and ino > 1
+    assert attr.typ == TYPE_DIRECTORY and attr.nlink == 2
+    st, ino2, attr2 = m.lookup(CTX, ROOT_INODE, b"d1")
+    assert st == 0 and ino2 == ino
+    st, _, _ = m.mkdir(CTX, ROOT_INODE, b"d1", 0o755)
+    assert st == errno.EEXIST
+    # parent nlink reflects subdir
+    st, rattr = m.getattr(CTX, ROOT_INODE)
+    assert rattr.nlink == 3
+    assert m.rmdir(CTX, ROOT_INODE, b"d1") == 0
+    st, _, _ = m.lookup(CTX, ROOT_INODE, b"d1")
+    assert st == errno.ENOENT
+    assert m.rmdir(CTX, ROOT_INODE, b"d1") == errno.ENOENT
+
+
+def test_rmdir_notempty(m):
+    _, d, _ = m.mkdir(CTX, ROOT_INODE, b"d", 0o755)
+    m.create(CTX, d, b"f", 0o644)
+    assert m.rmdir(CTX, ROOT_INODE, b"d") == errno.ENOTEMPTY
+    assert m.unlink(CTX, d, b"f") == 0
+    assert m.rmdir(CTX, ROOT_INODE, b"d") == 0
+
+
+def test_create_unlink(m):
+    st, ino, attr = m.create(CTX, ROOT_INODE, b"f1", 0o644)
+    assert st == 0 and attr.typ == TYPE_FILE and attr.nlink == 1
+    assert m.close(CTX, ino) == 0
+    st, _, _ = m.create(CTX, ROOT_INODE, b"f1", 0o644, flags=os.O_EXCL)
+    assert st == errno.EEXIST
+    assert m.unlink(CTX, ROOT_INODE, b"f1") == 0
+    st, _ = m.getattr(CTX, ino)
+    assert st == errno.ENOENT
+
+
+def test_symlink(m):
+    st, ino, attr = m.symlink(CTX, ROOT_INODE, b"ln", b"/target/path")
+    assert st == 0 and attr.typ == TYPE_SYMLINK
+    st, target = m.readlink(CTX, ino)
+    assert st == 0 and target == b"/target/path"
+
+
+def test_hardlink(m):
+    st, ino, _ = m.create(CTX, ROOT_INODE, b"a", 0o644)
+    m.close(CTX, ino)
+    st, attr = m.link(CTX, ino, ROOT_INODE, b"b")
+    assert st == 0 and attr.nlink == 2
+    assert m.unlink(CTX, ROOT_INODE, b"a") == 0
+    st, attr = m.getattr(CTX, ino)
+    assert st == 0 and attr.nlink == 1
+    st, ino2, _ = m.lookup(CTX, ROOT_INODE, b"b")
+    assert ino2 == ino
+    # hardlink to directory is EPERM
+    _, d, _ = m.mkdir(CTX, ROOT_INODE, b"d", 0o755)
+    st, _ = m.link(CTX, d, ROOT_INODE, b"dl")
+    assert st == errno.EPERM
+
+
+def test_readdir(m):
+    _, d, _ = m.mkdir(CTX, ROOT_INODE, b"dir", 0o755)
+    names = [f"f{i}".encode() for i in range(10)]
+    for n in names:
+        st, ino, _ = m.create(CTX, d, n, 0o644)
+        assert st == 0
+        m.close(CTX, ino)
+    st, entries = m.readdir(CTX, d, want_attr=True)
+    assert st == 0
+    got = sorted(e.name for e in entries if e.name not in (b".", b".."))
+    assert got == sorted(names)
+    assert entries[0].name == b"." and entries[1].name == b".."
+
+
+def test_rename_basic(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"src", 0o644)
+    m.close(CTX, ino)
+    st, rino, _ = m.rename(CTX, ROOT_INODE, b"src", ROOT_INODE, b"dst")
+    assert st == 0 and rino == ino
+    assert m.lookup(CTX, ROOT_INODE, b"src")[0] == errno.ENOENT
+    assert m.lookup(CTX, ROOT_INODE, b"dst")[1] == ino
+
+
+def test_rename_across_dirs(m):
+    _, d1, _ = m.mkdir(CTX, ROOT_INODE, b"d1", 0o755)
+    _, d2, _ = m.mkdir(CTX, ROOT_INODE, b"d2", 0o755)
+    _, sub, _ = m.mkdir(CTX, d1, b"sub", 0o755)
+    st, _, _ = m.rename(CTX, d1, b"sub", d2, b"sub2")
+    assert st == 0
+    _, a1 = m.getattr(CTX, d1)
+    _, a2 = m.getattr(CTX, d2)
+    assert a1.nlink == 2 and a2.nlink == 3
+    _, sattr = m.getattr(CTX, sub)
+    assert sattr.parent == d2
+
+
+def test_rename_replace_and_flags(m):
+    _, a, _ = m.create(CTX, ROOT_INODE, b"a", 0o644)
+    _, b, _ = m.create(CTX, ROOT_INODE, b"b", 0o644)
+    m.close(CTX, a)
+    m.close(CTX, b)
+    st, _, _ = m.rename(CTX, ROOT_INODE, b"a", ROOT_INODE, b"b", RENAME_NOREPLACE)
+    assert st == errno.EEXIST
+    st, _, _ = m.rename(CTX, ROOT_INODE, b"a", ROOT_INODE, b"b")
+    assert st == 0
+    assert m.getattr(CTX, b)[0] == errno.ENOENT  # replaced inode freed
+    # exchange
+    _, c, _ = m.create(CTX, ROOT_INODE, b"c", 0o644)
+    m.close(CTX, c)
+    st, _, _ = m.rename(CTX, ROOT_INODE, b"b", ROOT_INODE, b"c", RENAME_EXCHANGE)
+    assert st == 0
+    assert m.lookup(CTX, ROOT_INODE, b"b")[1] == c
+    assert m.lookup(CTX, ROOT_INODE, b"c")[1] == a
+
+
+def test_rename_dir_into_own_subtree(m):
+    _, d1, _ = m.mkdir(CTX, ROOT_INODE, b"d1", 0o755)
+    _, d2, _ = m.mkdir(CTX, d1, b"d2", 0o755)
+    st, _, _ = m.rename(CTX, ROOT_INODE, b"d1", d2, b"bad")
+    assert st == errno.EINVAL
+
+
+def test_setattr_chmod_chown(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"f", 0o644)
+    m.close(CTX, ino)
+    st, attr = m.setattr(CTX, ino, SET_ATTR_MODE, Attr(mode=0o600))
+    assert st == 0 and attr.mode == 0o600
+    st, attr = m.setattr(CTX, ino, SET_ATTR_UID | SET_ATTR_GID, Attr(uid=1000, gid=1000))
+    assert st == 0 and attr.uid == 1000 and attr.gid == 1000
+    # non-owner can't chmod
+    other = Context(uid=2000, gid=2000, gids=(2000,))
+    st, _ = m.setattr(other, ino, SET_ATTR_MODE, Attr(mode=0o777))
+    assert st == errno.EPERM
+
+
+def test_permissions(m):
+    _, d, _ = m.mkdir(CTX, ROOT_INODE, b"priv", 0o700)
+    st, _, _ = m.create(USER, d, b"f", 0o644)
+    assert st == errno.EACCES
+    st, _, _ = m.lookup(USER, d, b"anything")
+    assert st == errno.EACCES
+    # open modes
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"rootfile", 0o600)
+    m.close(CTX, ino)
+    st, _ = m.open(USER, ino, os.O_RDONLY)
+    assert st == errno.EACCES
+
+
+def test_sticky_bit(m):
+    _, d, _ = m.mkdir(CTX, ROOT_INODE, b"tmp", 0o777)
+    m.setattr(CTX, d, SET_ATTR_MODE, Attr(mode=0o1777))
+    alice = Context(uid=1000, gid=1000, gids=(1000,))
+    bob = Context(uid=2000, gid=2000, gids=(2000,))
+    st, f, _ = m.create(alice, d, b"af", 0o644)
+    assert st == 0
+    m.close(alice, f)
+    assert m.unlink(bob, d, b"af") == errno.EACCES
+    assert m.unlink(alice, d, b"af") == 0
+
+
+def test_write_read_chunks(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"data", 0o644)
+    sid = m.new_slice()
+    assert sid > 0
+    st = m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=1 << 20, off=0, len=1 << 20))
+    assert st == 0
+    sid2 = m.new_slice()
+    assert sid2 != sid
+    st = m.write_chunk(ino, 0, 1 << 19, Slice(pos=1 << 19, id=sid2, size=1 << 20, off=0, len=1 << 20))
+    assert st == 0
+    _, attr = m.getattr(CTX, ino)
+    assert attr.length == (1 << 19) + (1 << 20)
+    st, slices = m.read_chunk(ino, 0)
+    assert st == 0 and len(slices) == 2
+    view = build_slice(slices)
+    # second write shadows the tail of the first
+    assert view[0].id == sid and view[0].len == 1 << 19
+    assert view[1].id == sid2 and view[1].len == 1 << 20
+    m.close(CTX, ino)
+
+
+def test_write_chunk_boundaries(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"big", 0o644)
+    sid = m.new_slice()
+    assert m.write_chunk(ino, 1, 0, Slice(pos=0, id=sid, size=4096, off=0, len=4096)) == 0
+    _, attr = m.getattr(CTX, ino)
+    assert attr.length == CHUNK_SIZE + 4096
+    assert m.write_chunk(ino, 0, CHUNK_SIZE, Slice(pos=CHUNK_SIZE, id=sid, size=1, off=0, len=1)) == errno.EINVAL
+    m.close(CTX, ino)
+
+
+def test_truncate(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"t", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=8192, off=0, len=8192))
+    st, attr = m.truncate(CTX, ino, 4096)
+    assert st == 0 and attr.length == 4096
+    st, attr = m.truncate(CTX, ino, 1 << 20)
+    assert st == 0 and attr.length == 1 << 20
+    m.close(CTX, ino)
+
+
+def test_delete_file_reclaims_slices(m):
+    deleted = []
+    m.on_msg(meta_interface.DELETE_SLICE, lambda sid, size: deleted.append((sid, size)))
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"del", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0, len=4096))
+    m.close(CTX, ino)
+    assert m.unlink(CTX, ROOT_INODE, b"del") == 0
+    n = m.cleanup_deleted_files()
+    assert n == 1
+    assert (sid, 4096) in deleted
+
+
+def test_open_unlink_sustained(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"of", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0, len=4096))
+    # file still open: unlink must keep data until close
+    assert m.unlink(CTX, ROOT_INODE, b"of") == 0
+    assert m.cleanup_deleted_files() == 0
+    m.close(CTX, ino)
+    assert m.cleanup_deleted_files() == 1
+
+
+def test_xattr(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"x", 0o644)
+    m.close(CTX, ino)
+    assert m.setxattr(CTX, ino, b"user.k1", b"v1") == 0
+    st, v = m.getxattr(CTX, ino, b"user.k1")
+    assert st == 0 and v == b"v1"
+    st, names = m.listxattr(CTX, ino)
+    assert st == 0 and b"user.k1" in names
+    assert m.removexattr(CTX, ino, b"user.k1") == 0
+    st, _ = m.getxattr(CTX, ino, b"user.k1")
+    assert st == errno.ENODATA
+    assert m.setxattr(CTX, ino, b"user.k2", b"v", flags=2) == errno.ENODATA  # REPLACE
+    assert m.setxattr(CTX, ino, b"user.k2", b"v", flags=1) == 0  # CREATE
+    assert m.setxattr(CTX, ino, b"user.k2", b"v", flags=1) == errno.EEXIST
+
+
+def test_statfs_accounting(m):
+    total0, avail0, iused0, _ = m.statfs(CTX)
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"s", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=1 << 20, off=0, len=1 << 20))
+    m.close(CTX, ino)
+    total, avail, iused, _ = m.statfs(CTX)
+    assert iused == iused0 + 1
+    assert avail0 - avail == 1 << 20
+    m.unlink(CTX, ROOT_INODE, b"s")
+    total, avail, iused, _ = m.statfs(CTX)
+    assert iused == iused0 and avail == avail0
+
+
+def test_volume_quota(m):
+    m.fmt.inodes = m.used_inodes() + 2
+    _, a, _ = m.create(CTX, ROOT_INODE, b"q1", 0o644)
+    _, b, _ = m.create(CTX, ROOT_INODE, b"q2", 0o644)
+    st, _, _ = m.create(CTX, ROOT_INODE, b"q3", 0o644)
+    assert st == errno.ENOSPC
+    m.fmt.inodes = 0
+
+
+def test_resolve_and_paths(m):
+    _, d1, _ = m.mkdir(CTX, ROOT_INODE, b"a", 0o755)
+    _, d2, _ = m.mkdir(CTX, d1, b"b", 0o755)
+    _, f, _ = m.create(CTX, d2, b"c.txt", 0o644)
+    m.close(CTX, f)
+    st, ino, attr = m.resolve(CTX, "/a/b/c.txt")
+    assert st == 0 and ino == f
+    assert m.get_paths(f) == ["/a/b/c.txt"]
+
+
+def test_summary_and_rmr(m):
+    _, d, _ = m.mkdir(CTX, ROOT_INODE, b"tree", 0o755)
+    _, sub, _ = m.mkdir(CTX, d, b"sub", 0o755)
+    for i in range(3):
+        _, f, _ = m.create(CTX, sub, f"f{i}".encode(), 0o644)
+        sid = m.new_slice()
+        m.write_chunk(f, 0, 0, Slice(pos=0, id=sid, size=1000, off=0, len=1000))
+        m.close(CTX, f)
+    st, s = m.summary(CTX, d)
+    assert st == 0 and s.files == 3 and s.dirs == 2 and s.length == 3000
+    st, n = m.remove_recursive(CTX, ROOT_INODE, b"tree")
+    assert st == 0 and n == 5
+    assert m.lookup(CTX, ROOT_INODE, b"tree")[0] == errno.ENOENT
+
+
+def test_copy_file_range(m):
+    _, src, _ = m.create(CTX, ROOT_INODE, b"cfr_src", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(src, 0, 0, Slice(pos=0, id=sid, size=8192, off=0, len=8192))
+    _, dst, _ = m.create(CTX, ROOT_INODE, b"cfr_dst", 0o644)
+    st, copied = m.copy_file_range(CTX, src, 0, dst, 0, 8192, 0)
+    assert st == 0 and copied == 8192
+    st, slices = m.read_chunk(dst, 0)
+    view = build_slice(slices)
+    assert view[0].id == sid and view[0].len == 8192
+    m.close(CTX, src)
+    m.close(CTX, dst)
+
+
+def test_flock(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"lk", 0o644)
+    m.close(CTX, ino)
+    assert m.flock(CTX, ino, owner=1, ltype="W") == 0
+    assert m.flock(CTX, ino, owner=2, ltype="W") == errno.EAGAIN
+    assert m.flock(CTX, ino, owner=2, ltype="R") == errno.EAGAIN
+    assert m.flock(CTX, ino, owner=1, ltype="U") == 0
+    assert m.flock(CTX, ino, owner=2, ltype="R") == 0
+    assert m.flock(CTX, ino, owner=3, ltype="R") == 0
+    assert m.flock(CTX, ino, owner=1, ltype="W") == errno.EAGAIN
+
+
+def test_setlk(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"plk", 0o644)
+    m.close(CTX, ino)
+    W, R, U = m.F_WRLCK, m.F_RDLCK, m.F_UNLCK
+    assert m.setlk(CTX, ino, owner=1, ltype=W, start=0, end=100) == 0
+    assert m.setlk(CTX, ino, owner=2, ltype=R, start=50, end=150) == errno.EAGAIN
+    assert m.setlk(CTX, ino, owner=2, ltype=R, start=100, end=200) == 0
+    st, lt, s, e, pid = m.getlk(CTX, ino, owner=2, ltype=W, start=0, end=50)
+    assert st == 0 and lt == W
+    assert m.setlk(CTX, ino, owner=1, ltype=U, start=0, end=100) == 0
+    assert m.setlk(CTX, ino, owner=2, ltype=W, start=0, end=50) == 0
+
+
+def test_trash(tmp_path):
+    c = new_client(f"sqlite3://{tmp_path}/trash.db")
+    c.init(Format(name="t", trash_days=1), force=True)
+    c.load()
+    c.new_session()
+    _, ino, _ = c.create(CTX, ROOT_INODE, b"doomed", 0o644)
+    sid = c.new_slice()
+    c.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0, len=4096))
+    c.close(CTX, ino)
+    assert c.unlink(CTX, ROOT_INODE, b"doomed") == 0
+    # inode still alive, parked in trash
+    st, attr = c.getattr(CTX, ino)
+    assert st == 0
+    delfiles, trash_count = c.scan_deleted_objects()
+    assert trash_count == 1
+    # expire everything in trash
+    import time as _t
+
+    assert c.cleanup_trash_before(_t.time() + 3600) >= 1
+    assert c.getattr(CTX, ino)[0] == errno.ENOENT
+    assert c.cleanup_deleted_files() == 1
+    c.close_session()
+
+
+def test_sessions(m):
+    sessions = m.do_list_sessions()
+    assert any(s.sid == m.sid for s in sessions)
+
+
+def test_list_slices(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"ls", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0, len=4096))
+    m.close(CTX, ino)
+    all_slices = m.list_slices()
+    assert any(s.id == sid for slices in all_slices.values() for s in slices)
+
+
+def test_compact_chunk(m):
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"cc", 0o644)
+    sids = []
+    for i in range(4):
+        sid = m.new_slice()
+        sids.append(sid)
+        m.write_chunk(ino, 0, i * 1000, Slice(pos=i * 1000, id=sid, size=1000, off=0, len=1000))
+    deleted = []
+    m.on_msg(meta_interface.DELETE_SLICE, lambda sid, size: deleted.append(sid))
+    new_id = m.new_slice()
+    assert m.compact_chunk(ino, 0, new_id, 4000, 4) == 0
+    st, slices = m.read_chunk(ino, 0)
+    assert len(slices) == 1 and slices[0].id == new_id and slices[0].len == 4000
+    assert sorted(deleted) == sorted(sids)
+    m.close(CTX, ino)
+
+
+def test_build_slice_overlays():
+    s1 = Slice(pos=0, id=1, size=100, off=0, len=100)
+    s2 = Slice(pos=50, id=2, size=100, off=0, len=100)
+    view = build_slice([s1, s2])
+    assert [(v.pos, v.id, v.len) for v in view] == [(0, 1, 50), (50, 2, 100)]
+    # hole between writes
+    s3 = Slice(pos=300, id=3, size=50, off=0, len=50)
+    view = build_slice([s1, s3])
+    assert [(v.pos, v.id, v.len) for v in view] == [(0, 1, 100), (100, 0, 200), (300, 3, 50)]
+    # full shadow
+    view = build_slice([s1, Slice(pos=0, id=4, size=100, off=0, len=100)])
+    assert [(v.pos, v.id, v.len) for v in view] == [(0, 4, 100)]
+
+
+def test_truncate_shrink_grow_reads_zeros(m):
+    """POSIX: region exposed by shrink-then-grow must read as zeros."""
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"tz", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=8192, off=0, len=8192))
+    m.truncate(CTX, ino, 4096)
+    m.truncate(CTX, ino, 8192)
+    st, slices = m.read_chunk(ino, 0)
+    view = build_slice(slices)
+    covering = [v for v in view if v.pos < 8192 and v.pos + v.len > 4096]
+    assert all(v.id == 0 for v in covering if v.pos >= 4096), view
+    m.close(CTX, ino)
+
+
+def test_copy_file_range_refcount(m):
+    """Shared slices must survive source deletion (refcount incremented)."""
+    deleted = []
+    m.on_msg(meta_interface.DELETE_SLICE, lambda sid, size: deleted.append(sid))
+    _, src, _ = m.create(CTX, ROOT_INODE, b"rc_src", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(src, 0, 0, Slice(pos=0, id=sid, size=4096, off=0, len=4096))
+    _, dst, _ = m.create(CTX, ROOT_INODE, b"rc_dst", 0o644)
+    m.copy_file_range(CTX, src, 0, dst, 0, 4096, 0)
+    m.close(CTX, src)
+    m.close(CTX, dst)
+    m.unlink(CTX, ROOT_INODE, b"rc_src")
+    m.cleanup_deleted_files()
+    assert sid not in deleted  # dst still references it
+    m.unlink(CTX, ROOT_INODE, b"rc_dst")
+    m.cleanup_deleted_files()
+    assert sid in deleted  # last reference gone
+
+
+def test_sustained_no_double_accounting(m):
+    total0, avail0, iused0, _ = m.statfs(CTX)
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"sus", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0, len=4096))
+    m.unlink(CTX, ROOT_INODE, b"sus")  # still open -> sustained
+    m.close(CTX, ino)
+    m.cleanup_deleted_files()
+    total, avail, iused, _ = m.statfs(CTX)
+    assert iused == iused0 and avail == avail0  # no drift, no double decrement
+
+
+def test_hardlink_parent_tracking(m):
+    _, d1, _ = m.mkdir(CTX, ROOT_INODE, b"hp1", 0o755)
+    _, d2, _ = m.mkdir(CTX, ROOT_INODE, b"hp2", 0o755)
+    _, ino, _ = m.create(CTX, d1, b"f", 0o644)
+    m.close(CTX, ino)
+    m.link(CTX, ino, d2, b"l1")
+    m.link(CTX, ino, d2, b"l2")
+    parents = m.get_parents(ino)
+    assert parents == {d1: 1, d2: 2}
+    m.unlink(CTX, d2, b"l1")
+    assert m.get_parents(ino) == {d1: 1, d2: 1}
