@@ -1,0 +1,9 @@
+"""Chunk/data store (reference: pkg/chunk, SURVEY.md §2.1).
+
+Splits write-once slices into <= block_size (default 4 MiB) blocks stored as
+individual objects, with compression, a local disk/memory cache, writeback
+staging, singleflight load dedup, and prefetching.
+"""
+
+from .cached_store import CachedStore, ChunkConfig, block_key, parse_block_key  # noqa: F401
+from .singleflight import SingleFlight  # noqa: F401

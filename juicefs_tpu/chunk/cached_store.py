@@ -1,0 +1,361 @@
+"""Cached chunk store (reference: pkg/chunk/cached_store.go).
+
+Write path (reference cached_store.go:282-516): slice data accumulates in
+per-block buffers; full blocks upload asynchronously on a worker pool
+(optionally staged to disk first for writeback mode); `finish` is the
+commit barrier that waits for every block.
+
+Read path (reference cached_store.go:96-204,673-749): cache lookup →
+singleflight load (ranged GET, or full-block GET when compressed) →
+populate cache → prefetch the next block.
+
+Block object key (reference cached_store.go:73-78):
+    chunks/{id//1e6}/{id//1e3}/{id}_{indx}_{bsize}
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..compress import new_compressor
+from ..object.interface import NotFoundError, ObjectStorage
+from ..utils import get_logger
+from .disk_cache import CacheManager
+from .mem_cache import MemCache
+from .prefetch import Prefetcher
+from .singleflight import SingleFlight
+
+logger = get_logger("chunk.store")
+
+
+def block_key(sid: int, indx: int, bsize: int) -> str:
+    return f"chunks/{sid // 1_000_000}/{sid // 1_000}/{sid}_{indx}_{bsize}"
+
+
+def parse_block_key(key: str) -> Optional[tuple[int, int, int]]:
+    """chunks/a/b/{id}_{indx}_{bsize} -> (id, indx, bsize)"""
+    if not key.startswith("chunks/"):
+        return None
+    base = key.rsplit("/", 1)[-1]
+    parts = base.split("_")
+    if len(parts) != 3:
+        return None
+    try:
+        return int(parts[0]), int(parts[1]), int(parts[2])
+    except ValueError:
+        return None
+
+
+@dataclass
+class ChunkConfig:
+    block_size: int = 4 << 20
+    compress: str = ""
+    cache_dirs: tuple[str, ...] = ("memory",)
+    cache_size: int = 1 << 30
+    writeback: bool = False
+    max_upload: int = 4
+    max_retries: int = 10
+    prefetch: int = 2
+    # hook for the TPU fingerprint plane: called with (key, raw_block)
+    # on every upload (SURVEY.md §7.4); None disables
+    fingerprint: Optional[Callable[[str, bytes], None]] = None
+
+
+class CachedStore:
+    """reference cached_store.go:636 cachedStore / NewCachedStore:751"""
+
+    def __init__(self, storage: ObjectStorage, config: ChunkConfig | None = None):
+        self.storage = storage
+        self.conf = config or ChunkConfig()
+        self.compressor = new_compressor(self.conf.compress)
+        if self.conf.cache_dirs == ("memory",):
+            self.cache = MemCache(self.conf.cache_size)
+        else:
+            self.cache = CacheManager(list(self.conf.cache_dirs), self.conf.cache_size)
+        self._pool = ThreadPoolExecutor(max_workers=self.conf.max_upload, thread_name_prefix="upload")
+        self._group = SingleFlight()
+        self._fetcher = Prefetcher(self._prefetch_block, workers=self.conf.prefetch)
+        self._pending_lock = threading.Lock()
+        self._pending_staged: dict[str, bytes] = {}  # writeback: key -> raw data
+        if self.conf.writeback:
+            self._recover_staging()
+
+    # -- helpers -----------------------------------------------------------
+    def _with_retry(self, op: str, fn: Callable[[], object]):
+        last: Exception | None = None
+        for attempt in range(self.conf.max_retries):
+            try:
+                return fn()
+            except NotFoundError:
+                raise
+            except Exception as e:
+                last = e
+                sleep = min(0.01 * (attempt + 1) ** 2, 3.0)  # quadratic backoff
+                logger.warning("%s failed (try %d): %s", op, attempt + 1, e)
+                time.sleep(sleep)
+        raise last  # type: ignore[misc]
+
+    def _put_block(self, key: str, raw: bytes) -> None:
+        """Compress (+fingerprint) and PUT one block
+        (reference cached_store.go:371-413 upload)."""
+        if self.conf.fingerprint is not None:
+            self.conf.fingerprint(key, raw)
+        data = self.compressor.compress(raw)
+        self._with_retry(f"PUT {key}", lambda: self.storage.put(key, data))
+
+    def _load_block(self, key: str, bsize: int, cache_after: bool = True) -> bytes:
+        """Singleflight full-block load (reference cached_store.go:673-749)."""
+
+        def do() -> bytes:
+            cached = self.cache.load(key)
+            if cached is not None:
+                return cached
+            with self._pending_lock:
+                staged = self._pending_staged.get(key)
+            if staged is not None:
+                return staged
+            data = self._with_retry(f"GET {key}", lambda: self.storage.get(key))
+            raw = self.compressor.decompress(data, bsize)
+            if len(raw) != bsize:
+                raise IOError(f"block {key}: expect {bsize} bytes, got {len(raw)}")
+            if cache_after:
+                self.cache.cache(key, raw)
+            return raw
+
+        return self._group.do(key, do)
+
+    def _prefetch_block(self, key_size) -> None:
+        key, bsize = key_size
+        if self.cache.load(key) is None:
+            try:
+                self._load_block(key, bsize)
+            except NotFoundError:
+                pass
+
+    # -- public API (reference chunk.go:37-46 ChunkStore) ------------------
+    def new_writer(self, sid: int) -> "WSlice":
+        return WSlice(self, sid)
+
+    def new_reader(self, sid: int, length: int) -> "RSlice":
+        return RSlice(self, sid, length)
+
+    def remove(self, sid: int, length: int) -> None:
+        bs = self.conf.block_size
+        for indx in range((length + bs - 1) // bs or 1):
+            bsize = min(bs, length - indx * bs) if length else 0
+            key = block_key(sid, indx, bsize)
+            self.cache.remove(key)
+            with self._pending_lock:
+                self._pending_staged.pop(key, None)
+            try:
+                self._with_retry(f"DELETE {key}", lambda k=key: self.storage.delete(k))
+            except Exception as e:
+                logger.warning("remove %s: %s", key, e)
+
+    def fill_cache(self, sid: int, length: int) -> None:
+        """Warm every block of a slice (reference vfs/fill.go FillCache)."""
+        bs = self.conf.block_size
+        for indx in range((length + bs - 1) // bs):
+            bsize = min(bs, length - indx * bs)
+            self._load_block(block_key(sid, indx, bsize), bsize)
+
+    def check_cache(self, sid: int, length: int) -> int:
+        """Number of cached blocks for a slice."""
+        bs = self.conf.block_size
+        n = 0
+        for indx in range((length + bs - 1) // bs):
+            bsize = min(bs, length - indx * bs)
+            if self.cache.load(block_key(sid, indx, bsize)) is not None:
+                n += 1
+        return n
+
+    def evict_cache(self, sid: int, length: int) -> None:
+        bs = self.conf.block_size
+        for indx in range((length + bs - 1) // bs):
+            bsize = min(bs, length - indx * bs)
+            self.cache.remove(block_key(sid, indx, bsize))
+
+    def flush_all(self, timeout: float = 60.0) -> None:
+        """Drain pending writeback uploads (used by fsync paths and tests)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._pending_lock:
+                if not self._pending_staged:
+                    return
+            time.sleep(0.01)
+        raise TimeoutError("writeback uploads did not drain")
+
+    # -- writeback recovery ------------------------------------------------
+    def _recover_staging(self) -> None:
+        """Re-upload blocks staged before a crash
+        (reference disk_cache.go:870 scanStaging + uploadStaging)."""
+        for key, path in self.cache.scan_staging().items():
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                continue
+            logger.warning("found staged block %s, uploading", key)
+            with self._pending_lock:
+                self._pending_staged[key] = raw
+            self._pool.submit(self._upload_staged, key, raw)
+
+    def _upload_staged(self, key: str, raw: bytes) -> None:
+        try:
+            self._put_block(key, raw)
+            self.cache.uploaded(key, len(raw))
+        finally:
+            with self._pending_lock:
+                self._pending_staged.pop(key, None)
+
+
+class WSlice:
+    """Writer for one slice (reference cached_store.go:262 wSlice)."""
+
+    def __init__(self, store: CachedStore, sid: int):
+        self.store = store
+        self.id = sid
+        self.bs = store.conf.block_size
+        self._blocks: dict[int, bytearray] = {}
+        self._length = 0
+        self._futures: list[Future] = []
+        self._uploaded: set[int] = set()
+        self._closed = False
+
+    def write_at(self, data: bytes, off: int) -> int:
+        """Copy into per-block page buffers (reference cached_store.go:282-325)."""
+        if self._closed:
+            raise IOError("write after finish/abort")
+        pos = off
+        mv = memoryview(data)
+        while mv:
+            indx = pos // self.bs
+            boff = pos % self.bs
+            if indx in self._uploaded:
+                raise IOError(f"block {indx} already uploaded (non-sequential flush)")
+            buf = self._blocks.get(indx)
+            if buf is None:
+                buf = bytearray()
+                self._blocks[indx] = buf
+            n = min(len(mv), self.bs - boff)
+            if boff + n > len(buf):
+                buf.extend(b"\x00" * (boff + n - len(buf)))
+            buf[boff : boff + n] = mv[:n]
+            mv = mv[n:]
+            pos += n
+        self._length = max(self._length, pos)
+        return pos - off
+
+    def flush_to(self, off: int) -> None:
+        """Upload all blocks fully below `off` (reference FlushTo:482)."""
+        for indx in sorted(self._blocks):
+            if (indx + 1) * self.bs <= off and indx not in self._uploaded:
+                self._upload_block(indx, self.bs)
+
+    def _upload_block(self, indx: int, bsize: int) -> None:
+        raw = bytes(self._blocks.pop(indx))
+        if len(raw) < bsize:
+            raw += b"\x00" * (bsize - len(raw))
+        self._uploaded.add(indx)
+        key = block_key(self.id, indx, bsize)
+        if self.store.conf.writeback:
+            # stage to disk, ack immediately, upload in background
+            # (reference cached_store.go:415-472 writeback branch)
+            path = self.store.cache.stage(key, raw)
+            with self.store._pending_lock:
+                self.store._pending_staged[key] = raw
+            if path is not None:
+                self.store._pool.submit(self.store._upload_staged, key, raw)
+            else:  # staging failed: fall back to sync-ish upload
+                self._futures.append(self.store._pool.submit(self.store._upload_staged, key, raw))
+        else:
+            fut = self.store._pool.submit(self.store._put_block, key, raw)
+            fut.add_done_callback(
+                lambda f, k=key, r=raw: self.store.cache.cache(k, r) if not f.exception() else None
+            )
+            self._futures.append(fut)
+
+    def finish(self, length: int) -> None:
+        """Commit barrier: upload remaining blocks, wait for all
+        (reference Finish:506)."""
+        if length > 0:
+            n_blocks = (length + self.bs - 1) // self.bs
+            last_size = length - (n_blocks - 1) * self.bs
+            for indx in range(n_blocks):
+                if indx in self._uploaded:
+                    continue
+                if indx not in self._blocks:
+                    self._blocks[indx] = bytearray()  # hole: zero-filled block
+                self._upload_block(indx, last_size if indx == n_blocks - 1 else self.bs)
+        errs = []
+        for fut in self._futures:
+            e = fut.exception()
+            if e is not None:
+                errs.append(e)
+        self._closed = True
+        if errs:
+            raise errs[0]
+
+    def abort(self) -> None:
+        self._closed = True
+        self._blocks.clear()
+        for fut in self._futures:
+            fut.cancel()
+        self.store.remove(self.id, (max(self._uploaded, default=-1) + 1) * self.bs)
+
+
+class RSlice:
+    """Reader for one slice (reference cached_store.go:84 rSlice)."""
+
+    def __init__(self, store: CachedStore, sid: int, length: int):
+        self.store = store
+        self.id = sid
+        self.length = length
+        self.bs = store.conf.block_size
+
+    def _block_size(self, indx: int) -> int:
+        return min(self.bs, self.length - indx * self.bs)
+
+    def read(self, off: int, size: int) -> bytes:
+        """Ranged read within the slice (reference ReadAt:96-204)."""
+        if off >= self.length or size <= 0:
+            return b""
+        size = min(size, self.length - off)
+        out = bytearray()
+        pos = off
+        end = off + size
+        while pos < end:
+            indx = pos // self.bs
+            boff = pos % self.bs
+            bsize = self._block_size(indx)
+            n = min(end - pos, bsize - boff)
+            key = block_key(self.id, indx, bsize)
+            cached = self.store.cache.load(key)
+            if cached is not None:
+                out += cached[boff : boff + n]
+            else:
+                small = n < bsize // 4 and self.store.compressor.name == ""
+                if small:
+                    # partial GET without caching (reference: range read path)
+                    with self.store._pending_lock:
+                        staged = self.store._pending_staged.get(key)
+                    if staged is not None:
+                        out += staged[boff : boff + n]
+                    else:
+                        out += self.store._with_retry(
+                            f"GET {key}[{boff}:{boff+n}]",
+                            lambda k=key, o=boff, ln=n: self.store.storage.get(k, o, ln),
+                        )
+                else:
+                    raw = self.store._load_block(key, bsize)
+                    out += raw[boff : boff + n]
+                # prefetch the next block of this slice
+                if (indx + 1) * self.bs < self.length:
+                    nsize = self._block_size(indx + 1)
+                    self.store._fetcher.fetch((block_key(self.id, indx + 1, nsize), nsize))
+            pos += n
+        return bytes(out)

@@ -1,0 +1,221 @@
+"""Disk block cache with writeback staging
+(reference: pkg/chunk/disk_cache.go).
+
+Layout under each cache dir (reference disk_cache.go cachePath/stagePath):
+    {dir}/raw/{key}       cached blocks (evictable, LRU by atime)
+    {dir}/rawstaging/{key} writeback blocks not yet uploaded (NOT evictable)
+
+Eviction keeps used space under `capacity` by removing oldest-atime entries
+(reference disk_cache.go:688 cleanup). Staged blocks survive process death
+and are rescanned on startup (reference disk_cache.go:870 scanStaging).
+
+Multiple cache dirs are supported through `CacheManager`, hashing keys over
+the dirs (reference disk_cache.go:922 cacheManager).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from typing import Optional
+
+from ..utils import get_logger
+
+logger = get_logger("chunk.cache")
+
+
+class DiskCache:
+    def __init__(self, dirpath: str, capacity: int = 1 << 30):
+        self.dir = dirpath
+        self.capacity = capacity
+        self._raw = os.path.join(dirpath, "raw")
+        self._staging = os.path.join(dirpath, "rawstaging")
+        os.makedirs(self._raw, exist_ok=True)
+        os.makedirs(self._staging, exist_ok=True)
+        self._lock = threading.Lock()
+        # key -> (size, atime); rebuilt from disk on startup
+        self._index: dict[str, tuple[int, float]] = {}
+        self._used = 0
+        self._scan_existing()
+
+    def _scan_existing(self) -> None:
+        for dirpath, _, filenames in os.walk(self._raw):
+            for fn in filenames:
+                p = os.path.join(dirpath, fn)
+                key = os.path.relpath(p, self._raw).replace(os.sep, "/")
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                self._index[key] = (st.st_size, st.st_atime)
+                self._used += st.st_size
+
+    def _raw_path(self, key: str) -> str:
+        return os.path.join(self._raw, key)
+
+    def _stage_path(self, key: str) -> str:
+        return os.path.join(self._staging, key)
+
+    def cache(self, key: str, data: bytes) -> None:
+        path = self._raw_path(key)
+        with self._lock:
+            if key in self._index:
+                return
+            self._index[key] = (len(data), time.time())
+            self._used += len(data)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("cache write failed %s: %s", key, e)
+            with self._lock:
+                if self._index.pop(key, None) is not None:
+                    self._used -= len(data)
+            return
+        self._maybe_evict()
+
+    def load(self, key: str) -> Optional[bytes]:
+        path = self._raw_path(key)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            # also serve from staging (writeback block not yet uploaded)
+            try:
+                with open(self._stage_path(key), "rb") as f:
+                    return f.read()
+            except OSError:
+                return None
+        with self._lock:
+            if key in self._index:
+                self._index[key] = (len(data), time.time())
+        return data
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            item = self._index.pop(key, None)
+            if item is not None:
+                self._used -= item[0]
+        for p in (self._raw_path(key), self._stage_path(key)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _maybe_evict(self) -> None:
+        with self._lock:
+            if self._used <= self.capacity:
+                return
+            victims = sorted(self._index.items(), key=lambda kv: kv[1][1])
+            to_free = self._used - int(self.capacity * 0.8)  # evict to 80%
+            freed = 0
+            doomed = []
+            for key, (size, _) in victims:
+                doomed.append(key)
+                freed += size
+                if freed >= to_free:
+                    break
+            for key in doomed:
+                item = self._index.pop(key, None)
+                if item is not None:
+                    self._used -= item[0]
+        for key in doomed:
+            try:
+                os.unlink(self._raw_path(key))
+            except OSError:
+                pass
+
+    # -- writeback staging -------------------------------------------------
+    def stage(self, key: str, data: bytes) -> Optional[str]:
+        """Persist a block pending upload; returns its path
+        (reference disk_cache.go:655 stage)."""
+        path = self._stage_path(key)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return path
+        except OSError as e:
+            logger.warning("stage failed %s: %s", key, e)
+            return None
+
+    def uploaded(self, key: str, size: int) -> None:
+        """Move a staged block into the normal cache after upload
+        (reference disk_cache.go uploaded)."""
+        spath = self._stage_path(key)
+        rpath = self._raw_path(key)
+        try:
+            os.makedirs(os.path.dirname(rpath), exist_ok=True)
+            os.replace(spath, rpath)
+            st = os.stat(rpath)
+            with self._lock:
+                if key not in self._index:
+                    self._index[key] = (st.st_size, time.time())
+                    self._used += st.st_size
+        except OSError:
+            pass
+        self._maybe_evict()
+
+    def scan_staging(self) -> dict[str, str]:
+        """key -> path of blocks written back before a crash
+        (reference disk_cache.go:870 scanStaging)."""
+        out = {}
+        for dirpath, _, filenames in os.walk(self._staging):
+            for fn in filenames:
+                if fn.endswith(".tmp"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                out[os.path.relpath(p, self._staging).replace(os.sep, "/")] = p
+        return out
+
+    def stats(self) -> tuple[int, int]:
+        with self._lock:
+            return len(self._index), self._used
+
+
+class CacheManager:
+    """Hash keys over multiple cache dirs (reference disk_cache.go:922)."""
+
+    def __init__(self, dirs: list[str], capacity: int = 1 << 30):
+        self._stores = [DiskCache(d, capacity // max(len(dirs), 1)) for d in dirs]
+
+    def _pick(self, key: str) -> DiskCache:
+        return self._stores[zlib.crc32(key.encode()) % len(self._stores)]
+
+    def cache(self, key, data):
+        self._pick(key).cache(key, data)
+
+    def load(self, key):
+        return self._pick(key).load(key)
+
+    def remove(self, key):
+        self._pick(key).remove(key)
+
+    def stage(self, key, data):
+        return self._pick(key).stage(key, data)
+
+    def uploaded(self, key, size):
+        self._pick(key).uploaded(key, size)
+
+    def scan_staging(self):
+        out = {}
+        for s in self._stores:
+            out.update(s.scan_staging())
+        return out
+
+    def stats(self):
+        n, used = 0, 0
+        for s in self._stores:
+            a, b = s.stats()
+            n += a
+            used += b
+        return n, used

@@ -1,0 +1,56 @@
+"""In-RAM block cache (reference: pkg/chunk/mem_cache.go) — used with
+`cache_dir="memory"` (gc/fsck runs) and in tests."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class MemCache:
+    def __init__(self, capacity: int = 256 << 20):
+        self.capacity = capacity
+        self._data: dict[str, tuple[bytes, float]] = {}
+        self._used = 0
+        self._lock = threading.Lock()
+
+    def cache(self, key: str, data: bytes) -> None:
+        with self._lock:
+            if key in self._data:
+                return
+            self._data[key] = (bytes(data), time.time())
+            self._used += len(data)
+            while self._used > self.capacity and self._data:
+                victim = min(self._data, key=lambda k: self._data[k][1])
+                buf, _ = self._data.pop(victim)
+                self._used -= len(buf)
+
+    def load(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            item = self._data.get(key)
+            if item is None:
+                return None
+            data, _ = item
+            self._data[key] = (data, time.time())
+            return data
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            item = self._data.pop(key, None)
+            if item is not None:
+                self._used -= len(item[0])
+
+    def stats(self) -> tuple[int, int]:
+        with self._lock:
+            return len(self._data), self._used
+
+    # staging interface (no-op for memory cache: writeback not supported)
+    def stage(self, key: str, data: bytes) -> Optional[str]:
+        return None
+
+    def uploaded(self, key: str, size: int) -> None:
+        pass
+
+    def scan_staging(self) -> dict[str, str]:
+        return {}

@@ -1,0 +1,43 @@
+"""Prefetcher: N workers warming upcoming blocks (reference: pkg/chunk/prefetch.go:21-66)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Hashable
+
+
+class Prefetcher:
+    def __init__(self, fetch: Callable[[Hashable], None], workers: int = 2, depth: int = 64):
+        self._fetch = fetch
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._pending: set[Hashable] = set()
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True, name=f"prefetch-{i}")
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def fetch(self, key: Hashable) -> None:
+        with self._lock:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+        try:
+            self._q.put_nowait(key)
+        except queue.Full:
+            with self._lock:
+                self._pending.discard(key)
+
+    def _run(self) -> None:
+        while True:
+            key = self._q.get()
+            try:
+                self._fetch(key)
+            except Exception:
+                pass
+            finally:
+                with self._lock:
+                    self._pending.discard(key)

@@ -33,6 +33,7 @@ from .types import (
     TRASH_INODE,
     SET_ATTR_GID,
     SET_ATTR_MODE,
+    SET_ATTR_SIZE,
     SET_ATTR_UID,
     TYPE_DIRECTORY,
     TYPE_FILE,
@@ -231,6 +232,15 @@ class BaseMeta(interface.Meta):
         st, cur = self.do_getattr(ino)
         if st:
             return st, Attr()
+        if flags & SET_ATTR_SIZE:
+            # FUSE truncate-via-setattr path (reference base.go SetAttr)
+            st, out = self.truncate(ctx, ino, attr.length)
+            if st:
+                return st, Attr()
+            flags &= ~SET_ATTR_SIZE
+            if flags == 0:
+                return 0, out
+            cur = out
         if ctx.uid != 0 and ctx.check_permission:
             if flags & SET_ATTR_MODE and ctx.uid != cur.uid:
                 return errno.EPERM, Attr()
@@ -509,40 +519,56 @@ class BaseMeta(interface.Meta):
         return 0, s
 
     def _summarize(self, ctx, ino, attr, s: Summary) -> None:
-        if attr.typ == TYPE_DIRECTORY:
-            s.dirs += 1
-            s.size += 4096
-            st, entries = self.do_readdir(ctx, ino, True)
-            if st:
-                return
-            for e in entries:
-                self._summarize(ctx, e.inode, e.attr, s)
-        else:
-            s.files += 1
-            s.length += attr.length
-            s.size += (attr.length + 4095) // 4096 * 4096
+        # iterative: no Python recursion limit on deep trees
+        stack = [(ino, attr)]
+        while stack:
+            cino, cattr = stack.pop()
+            if cattr.typ == TYPE_DIRECTORY:
+                s.dirs += 1
+                s.size += 4096
+                st, entries = self.do_readdir(ctx, cino, True)
+                if st:
+                    continue
+                stack.extend((e.inode, e.attr) for e in entries)
+            else:
+                s.files += 1
+                s.length += cattr.length
+                s.size += (cattr.length + 4095) // 4096 * 4096
 
     def remove_recursive(self, ctx, parent: int, name: bytes, skip_trash=False) -> tuple[int, int]:
-        """rmr: depth-first delete (reference base.go Remove / cmd rmr)."""
+        """rmr: post-order delete, iterative so arbitrarily deep trees cannot
+        exhaust the Python stack (reference base.go Remove / cmd rmr)."""
         st, ino, attr = self.lookup(ctx, parent, name)
         if st:
             return st, 0
         removed = 0
-        if attr.typ == TYPE_DIRECTORY:
-            st, entries = self.do_readdir(ctx, ino, True)
+        if attr.typ != TYPE_DIRECTORY:
+            st = self.do_unlink(ctx, parent, name, skip_trash)
+            return st, (1 if st == 0 else 0)
+        # stack holds (parent, name, ino, expanded); a dir is deleted only
+        # after its expanded children have been processed
+        stack: list[tuple[int, bytes, int, bool]] = [(parent, name, ino, False)]
+        while stack:
+            p, n, i, expanded = stack.pop()
+            if expanded:
+                st = self.do_rmdir(ctx, p, n, skip_trash)
+                if st:
+                    return st, removed
+                removed += 1
+                continue
+            stack.append((p, n, i, True))
+            st, entries = self.do_readdir(ctx, i, True)
             if st:
                 return st, removed
             for e in entries:
-                st2, n = self.remove_recursive(ctx, ino, e.name, skip_trash)
-                removed += n
-                if st2:
-                    return st2, removed
-            st = self.do_rmdir(ctx, parent, name, skip_trash)
-        else:
-            st = self.do_unlink(ctx, parent, name, skip_trash)
-        if st == 0:
-            removed += 1
-        return st, removed
+                if e.attr.typ == TYPE_DIRECTORY:
+                    stack.append((i, e.name, e.inode, False))
+                else:
+                    st = self.do_unlink(ctx, i, e.name, skip_trash)
+                    if st:
+                        return st, removed
+                    removed += 1
+        return 0, removed
 
     def get_paths(self, ino: int) -> list[str]:
         """Reverse-resolve inode to path(s) (reference base.go GetPaths)."""

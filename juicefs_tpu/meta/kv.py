@@ -29,6 +29,7 @@ import calendar
 import errno
 import json
 import struct
+import threading
 import time
 from typing import Optional
 
@@ -83,9 +84,41 @@ class KVMeta(BaseMeta):
     def __init__(self, client: TKVClient, addr: str = ""):
         super().__init__(addr)
         self.client = client
+        self._nlocal = threading.local()  # deferred notification buffer
 
     def name(self) -> str:
         return self.client.name
+
+    # ---- transactions with post-commit notifications ---------------------
+    def _txn_notify(self, fn):
+        """Run a transaction whose body may queue DELETE_SLICE/COMPACT_CHUNK
+        messages; fire them only after a successful commit so callbacks never
+        act on uncommitted (or rolled-back) state."""
+        if getattr(self._nlocal, "msgs", None) is not None:
+            return self.client.txn(fn)  # nested: outermost commit fires
+        msgs: list = []
+        self._nlocal.msgs = msgs
+        try:
+            def wrapped(tx):
+                del msgs[:]  # retry: drop notifications from the failed attempt
+                return fn(tx)
+
+            result = self.client.txn(wrapped)
+        except BaseException:
+            del msgs[:]
+            raise
+        finally:
+            self._nlocal.msgs = None
+        for mtype, args in msgs:
+            self._notify(mtype, *args)
+        return result
+
+    def _queue_notify(self, mtype: int, *args) -> None:
+        msgs = getattr(self._nlocal, "msgs", None)
+        if msgs is not None:
+            msgs.append((mtype, args))
+        else:
+            self._notify(mtype, *args)
 
     # ---- key builders (reference tkv.go:198-296) -------------------------
     @staticmethod
@@ -461,13 +494,16 @@ class KVMeta(BaseMeta):
 
     def _trash_entry(self, tx: KVTxn, parent: int, name: bytes, ino: int, typ: int) -> None:
         """Move a doomed entry under the hourly trash dir
-        (reference base.go trash handling: entries renamed {parent}-{ino}-{name})."""
-        hour = time.strftime("%Y-%m-%d-%H", time.gmtime())
-        hname = hour.encode()
-        htyp, hino = self._get_entry(tx, TRASH_INODE, hname)
-        if hino == 0:
-            hino = self.new_inode()
-            now = time.time()
+        (reference base.go trash handling: entries renamed {parent}-{ino}-{name}).
+
+        Hour-dir inodes are deterministic (TRASH_INODE + 1 + hours since
+        epoch): no id allocation inside the transaction, and every trash
+        directory sorts >= TRASH_INODE so `parent < TRASH_INODE` reliably
+        detects "not already in trash"."""
+        now = time.time()
+        hname = time.strftime("%Y-%m-%d-%H", time.gmtime(now)).encode()
+        hino = TRASH_INODE + 1 + int(now // 3600)
+        if self._get_attr(tx, hino) is None:
             hattr = Attr(typ=TYPE_DIRECTORY, mode=0o555, nlink=2, length=4096, parent=TRASH_INODE)
             hattr.touch_mtime(now)
             self._set_attr(tx, hino, hattr)
@@ -477,6 +513,7 @@ class KVMeta(BaseMeta):
         attr = self._get_attr(tx, ino)
         if attr is not None:
             attr.parent = hino
+            attr.touch_ctime(now)
             self._set_attr(tx, ino, attr)
 
     def do_unlink(self, ctx, parent, name, skip_trash=False) -> int:
@@ -503,9 +540,8 @@ class KVMeta(BaseMeta):
             if attr is None:  # dangling entry
                 return 0
             if trash and attr.nlink == 1:
+                # _trash_entry re-reads, re-parents, and writes the attr itself
                 self._trash_entry(tx, parent, name, ino, typ)
-                attr.touch_ctime(now)
-                self._set_attr(tx, ino, attr)
                 self._update_dirstat(tx, parent, -attr.length, -_align4k(attr.length), -1)
                 return 0
             attr.nlink -= 1
@@ -827,10 +863,10 @@ class KVMeta(BaseMeta):
             self._set_attr(tx, ino, attr)
             data = tx.append(self._chunk_key(ino, indx), slc.encode())
             if len(data) // Slice.ENCODED_LEN > 100:
-                self._notify(interface.COMPACT_CHUNK, ino, indx)
+                self._queue_notify(interface.COMPACT_CHUNK, ino, indx)
             return 0
 
-        return self.client.txn(fn)
+        return self._txn_notify(fn)
 
     def do_truncate(self, ctx, ino, length) -> tuple[int, Attr]:
         def fn(tx: KVTxn):
@@ -877,7 +913,7 @@ class KVMeta(BaseMeta):
                         tx.append(self._chunk_key(ino, bindx), hole.encode())
             return 0, attr
 
-        return self.client.txn(fn)
+        return self._txn_notify(fn)
 
     def do_fallocate(self, ctx, ino, mode, off, size) -> int:
         FALLOC_KEEP_SIZE, FALLOC_PUNCH_HOLE, FALLOC_ZERO_RANGE = 0x1, 0x2, 0x10
@@ -931,7 +967,7 @@ class KVMeta(BaseMeta):
         cnt -= 1
         if cnt < 0:
             tx.delete(key)
-            self._notify(interface.DELETE_SLICE, sid, size)
+            self._queue_notify(interface.DELETE_SLICE, sid, size)
         else:
             tx.set(key, _I64.pack(cnt))
 
@@ -960,7 +996,7 @@ class KVMeta(BaseMeta):
                     tx.delete(key)
                 return 0
 
-            self.client.txn(fn)
+            self._txn_notify(fn)
         self.client.txn(lambda tx: tx.delete(self._delfile_key(ino, length)))
 
     def do_list_slices(self) -> dict[int, list[Slice]]:
@@ -994,7 +1030,7 @@ class KVMeta(BaseMeta):
                     self._decref_slice(tx, s.id, s.size)
             return 0
 
-        return self.client.txn(fn)
+        return self._txn_notify(fn)
 
     # ---- xattr -----------------------------------------------------------
     def do_getxattr(self, ino, name) -> tuple[int, bytes]:

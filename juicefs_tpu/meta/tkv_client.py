@@ -288,7 +288,10 @@ class SqliteKV(TKVClient):
                     conn.execute("COMMIT")
                     return result
                 except sqlite3.OperationalError as e:
-                    conn.execute("ROLLBACK")
+                    try:
+                        conn.execute("ROLLBACK")
+                    except sqlite3.OperationalError:
+                        pass  # BEGIN itself failed: no transaction to roll back
                     last = e
                     time.sleep(min(0.001 * (1 << min(attempt, 8)), 0.1))
                 except BaseException:

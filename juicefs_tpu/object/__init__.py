@@ -1,0 +1,66 @@
+"""Object storage abstraction (reference: pkg/object, SURVEY.md §2.1).
+
+Drivers register by scheme; `create_storage` composes the optional wrappers
+exactly like the reference mount path (cmd/mount.go NewReloadableStorage →
+prefix/shard/encrypt):
+
+    create_storage("file:///var/jfs/vol/")       local-disk store
+    create_storage("mem://")                     in-proc store (tests)
+    sharded(...)  with_prefix(...)  new_encrypted(...)  new_checksummed(...)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .interface import Obj, ObjectStorage, NotFoundError
+from .file import FileStorage
+from .mem import MemStorage
+from .prefix import with_prefix
+from .sharding import sharded
+from .checksum import new_checksummed, crc32c
+from .encrypt import (
+    AESGCMDataEncryptor,
+    RSAEncryptor,
+    new_encrypted,
+    generate_rsa_key_pem,
+)
+
+_registry: dict[str, Callable[[str], ObjectStorage]] = {}
+
+
+def register(scheme: str, factory: Callable[[str], ObjectStorage]) -> None:
+    _registry[scheme] = factory
+
+
+def create_storage(uri: str) -> ObjectStorage:
+    """Open an object store by URI (reference object_storage.go CreateStorage)."""
+    if "://" not in uri:
+        uri = "file://" + uri
+    scheme, addr = uri.split("://", 1)
+    scheme = scheme.lower()
+    if scheme not in _registry:
+        raise ValueError(f"invalid object storage: {scheme}")
+    return _registry[scheme](addr)
+
+
+register("file", lambda addr: FileStorage(addr))
+register("mem", lambda addr: MemStorage(addr))
+
+__all__ = [
+    "Obj",
+    "ObjectStorage",
+    "NotFoundError",
+    "FileStorage",
+    "MemStorage",
+    "create_storage",
+    "register",
+    "with_prefix",
+    "sharded",
+    "new_checksummed",
+    "crc32c",
+    "new_encrypted",
+    "AESGCMDataEncryptor",
+    "RSAEncryptor",
+    "generate_rsa_key_pem",
+]

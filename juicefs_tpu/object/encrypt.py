@@ -1,0 +1,124 @@
+"""Envelope encryption wrapper (reference: pkg/object/encrypt.go:136-216).
+
+Scheme (same shape as the reference):
+  per-object random 256-bit data key + nonce
+  body  = AES-256-GCM(data_key, nonce, plaintext)
+  object = len(wrapped_key) || wrapped_key || nonce || body
+  wrapped_key = RSA-OAEP(public_key, data_key)
+
+The RSA key pair is the volume's master key (PEM, optionally password
+protected — reference encrypt.go:66-123 ParseRsaPrivateKeyFromPem).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from .interface import Obj, ObjectStorage
+
+
+def generate_rsa_key_pem(bits: int = 2048, password: bytes | None = None) -> bytes:
+    key = rsa.generate_private_key(public_exponent=65537, key_size=bits)
+    enc = (
+        serialization.BestAvailableEncryption(password)
+        if password
+        else serialization.NoEncryption()
+    )
+    return key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8, enc
+    )
+
+
+class RSAEncryptor:
+    """Key encryptor: wraps per-object data keys (reference encrypt.go:125-145)."""
+
+    def __init__(self, pem: bytes, password: bytes | None = None):
+        self._key = serialization.load_pem_private_key(pem, password)
+        self._pad = padding.OAEP(
+            mgf=padding.MGF1(algorithm=hashes.SHA256()),
+            algorithm=hashes.SHA256(),
+            label=None,
+        )
+
+    def encrypt(self, data_key: bytes) -> bytes:
+        return self._key.public_key().encrypt(data_key, self._pad)
+
+    def decrypt(self, wrapped: bytes) -> bytes:
+        return self._key.decrypt(wrapped, self._pad)
+
+    @property
+    def wrapped_len(self) -> int:
+        return self._key.key_size // 8
+
+
+class AESGCMDataEncryptor:
+    """Per-object AES-256-GCM (reference encrypt.go:147-216 dataEncryptor)."""
+
+    NONCE = 12
+
+    def __init__(self, key_encryptor: RSAEncryptor):
+        self._ke = key_encryptor
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        dk = os.urandom(32)
+        nonce = os.urandom(self.NONCE)
+        body = AESGCM(dk).encrypt(nonce, plaintext, None)
+        wrapped = self._ke.encrypt(dk)
+        return struct.pack(">I", len(wrapped)) + wrapped + nonce + body
+
+    def decrypt(self, blob: bytes) -> bytes:
+        (klen,) = struct.unpack_from(">I", blob)
+        wrapped = blob[4 : 4 + klen]
+        nonce = blob[4 + klen : 4 + klen + self.NONCE]
+        body = blob[4 + klen + self.NONCE :]
+        dk = self._ke.decrypt(wrapped)
+        return AESGCM(dk).decrypt(nonce, body, None)
+
+    @property
+    def overhead(self) -> int:
+        # length header + wrapped key + nonce + GCM tag: fixed per volume key
+        return 4 + self._ke.wrapped_len + self.NONCE + 16
+
+
+class _Encrypted(ObjectStorage):
+    def __init__(self, store: ObjectStorage, enc: AESGCMDataEncryptor):
+        self._s = store
+        self._e = enc
+
+    def string(self) -> str:
+        return self._s.string()
+
+    def create(self) -> None:
+        self._s.create()
+
+    def put(self, key, data):
+        self._s.put(key, self._e.encrypt(data))
+
+    def get(self, key, off=0, limit=-1):
+        # ciphertext is not seekable: fetch whole object, slice after decrypt
+        # (reference encrypt.go Get does the same)
+        data = self._e.decrypt(self._s.get(key))
+        if limit < 0:
+            return data[off:]
+        return data[off : off + limit]
+
+    def delete(self, key):
+        self._s.delete(key)
+
+    def head(self, key) -> Obj:
+        o = self._s.head(key)
+        return Obj(key=o.key, size=max(o.size - self._e.overhead, 0), mtime=o.mtime, is_dir=o.is_dir)
+
+    def list_all(self, prefix: str = "", marker: str = "") -> Iterator[Obj]:
+        for o in self._s.list_all(prefix, marker):
+            yield Obj(key=o.key, size=max(o.size - self._e.overhead, 0), mtime=o.mtime, is_dir=o.is_dir)
+
+
+def new_encrypted(store: ObjectStorage, pem: bytes, password: bytes | None = None) -> ObjectStorage:
+    return _Encrypted(store, AESGCMDataEncryptor(RSAEncryptor(pem, password)))

@@ -1,0 +1,206 @@
+"""Chunk store tests (mirrors reference pkg/chunk/cached_store_test.go:
+mem object store + temp disk cache)."""
+
+import os
+import time
+
+import pytest
+
+from juicefs_tpu.chunk import CachedStore, ChunkConfig, block_key, parse_block_key
+from juicefs_tpu.chunk.disk_cache import DiskCache
+from juicefs_tpu.object import MemStorage
+
+
+def make_store(tmp_path=None, **kw):
+    if tmp_path is not None:
+        kw.setdefault("cache_dirs", (str(tmp_path / "cache"),))
+    return CachedStore(MemStorage(), ChunkConfig(block_size=1 << 16, **kw))
+
+
+def test_block_key_scheme():
+    assert block_key(1234567, 3, 4096) == "chunks/1/1234/1234567_3_4096"
+    assert parse_block_key("chunks/1/1234/1234567_3_4096") == (1234567, 3, 4096)
+    assert parse_block_key("meta/dump.json") is None
+    assert parse_block_key("chunks/bad") is None
+
+
+@pytest.mark.parametrize("compress", ["", "lz4", "zstd"])
+def test_write_read_roundtrip(compress):
+    store = make_store(compress=compress)
+    data = os.urandom(200_000)  # ~3 blocks of 64 KiB
+    w = store.new_writer(7)
+    w.write_at(data, 0)
+    w.finish(len(data))
+    r = store.new_reader(7, len(data))
+    assert r.read(0, len(data)) == data
+    # ranged reads
+    assert r.read(1000, 500) == data[1000:1500]
+    assert r.read(65536 - 100, 200) == data[65536 - 100 : 65536 + 100]  # cross block
+    assert r.read(len(data) - 10, 100) == data[-10:]  # clamped at end
+
+
+def test_sparse_write_zero_fill():
+    store = make_store()
+    w = store.new_writer(9)
+    w.write_at(b"tail", 70000)  # block 1, offset beyond start
+    w.finish(70004)
+    r = store.new_reader(9, 70004)
+    out = r.read(0, 70004)
+    assert out[:65536] == b"\x00" * 65536
+    assert out[65536:70000] == b"\x00" * (70000 - 65536)
+    assert out[70000:] == b"tail"
+
+
+def test_flush_to_then_finish():
+    store = make_store()
+    w = store.new_writer(11)
+    data = os.urandom(3 * 65536 + 123)
+    w.write_at(data, 0)
+    w.flush_to(2 * 65536)  # first two blocks upload early
+    w.write_at(b"xx", 3 * 65536 + 123)
+    w.finish(3 * 65536 + 125)
+    r = store.new_reader(11, 3 * 65536 + 125)
+    assert r.read(0, len(data)) == data
+    assert r.read(3 * 65536 + 123, 2) == b"xx"
+
+
+def test_remove():
+    store = make_store()
+    w = store.new_writer(13)
+    w.write_at(b"abc", 0)
+    w.finish(3)
+    assert store.new_reader(13, 3).read(0, 3) == b"abc"
+    store.remove(13, 3)
+    from juicefs_tpu.object import NotFoundError
+
+    with pytest.raises(NotFoundError):
+        store.new_reader(13, 3).read(0, 3)
+
+
+def test_disk_cache_roundtrip(tmp_path):
+    store = make_store(tmp_path)
+    data = os.urandom(130_000)
+    w = store.new_writer(17)
+    w.write_at(data, 0)
+    w.finish(len(data))
+    r = store.new_reader(17, len(data))
+    assert r.read(0, len(data)) == data  # populates disk cache
+    # second read served from cache even if object deleted behind our back
+    store.storage.delete(block_key(17, 0, 65536))
+    assert store.new_reader(17, len(data)).read(0, 65536) == data[:65536]
+    n, used = store.cache.stats()
+    assert n >= 1 and used > 0
+
+
+def test_disk_cache_eviction(tmp_path):
+    dc = DiskCache(str(tmp_path / "small"), capacity=100_000)
+    for i in range(10):
+        dc.cache(f"chunks/0/0/{i}_0_20000", bytes(20000))
+        time.sleep(0.01)
+    n, used = dc.stats()
+    assert used <= 100_000
+    assert n < 10  # something evicted
+    # oldest evicted first: newest key must survive
+    assert dc.load("chunks/0/0/9_0_20000") is not None
+
+
+def test_writeback_staging(tmp_path):
+    store = make_store(tmp_path, writeback=True)
+    data = os.urandom(65536 * 2)
+    w = store.new_writer(19)
+    w.write_at(data, 0)
+    w.finish(len(data))  # returns fast; upload happens in background
+    store.flush_all()
+    # object eventually in storage
+    assert store.storage.get(block_key(19, 0, 65536)) == data[:65536]
+    r = store.new_reader(19, len(data))
+    assert r.read(0, len(data)) == data
+
+
+def test_writeback_read_before_upload(tmp_path):
+    """Reads must see staged data even before background upload lands."""
+    store = make_store(tmp_path, writeback=True)
+    data = os.urandom(65536)
+    w = store.new_writer(23)
+    w.write_at(data, 0)
+    w.finish(len(data))
+    r = store.new_reader(23, len(data))
+    assert r.read(100, 200) == data[100:300]
+    store.flush_all()
+
+
+def test_staging_recovery(tmp_path):
+    """Blocks staged before a crash are re-uploaded on startup
+    (reference disk_cache.go scanStaging)."""
+    cache_dir = tmp_path / "cache"
+    storage = MemStorage()
+    # simulate a crashed writer: block staged but never uploaded
+    dc = DiskCache(str(cache_dir))
+    data = os.urandom(65536)
+    key = block_key(29, 0, 65536)
+    dc.stage(key, data)
+    store = CachedStore(
+        storage,
+        ChunkConfig(block_size=1 << 16, cache_dirs=(str(cache_dir),), writeback=True),
+    )
+    store.flush_all()
+    assert storage.get(key) == data
+
+
+def test_fill_and_check_cache():
+    store = make_store()
+    data = os.urandom(65536 * 2)
+    w = store.new_writer(31)
+    w.write_at(data, 0)
+    w.finish(len(data))
+    store.evict_cache(31, len(data))
+    assert store.check_cache(31, len(data)) == 0
+    store.fill_cache(31, len(data))
+    assert store.check_cache(31, len(data)) == 2
+
+
+def test_fingerprint_hook():
+    seen = []
+    store = CachedStore(
+        MemStorage(),
+        ChunkConfig(block_size=1 << 16, fingerprint=lambda k, raw: seen.append((k, len(raw)))),
+    )
+    data = os.urandom(100_000)
+    w = store.new_writer(37)
+    w.write_at(data, 0)
+    w.finish(len(data))
+    assert len(seen) == 2
+    assert seen[0][0] == block_key(37, 0, 65536)
+
+
+def test_concurrent_readers_singleflight():
+    """Many readers of one uncached block trigger a single GET."""
+    gets = []
+    storage = MemStorage()
+    orig = storage.get
+
+    def counting_get(key, off=0, limit=-1):
+        gets.append(key)
+        time.sleep(0.01)
+        return orig(key, off, limit)
+
+    storage.get = counting_get
+    store = CachedStore(storage, ChunkConfig(block_size=1 << 16))
+    data = os.urandom(65536)
+    w = store.new_writer(41)
+    w.write_at(data, 0)
+    w.finish(len(data))
+    store.evict_cache(41, len(data))
+    gets.clear()
+    import threading
+
+    results = []
+    ts = [
+        threading.Thread(target=lambda: results.append(store.new_reader(41, 65536).read(0, 65536)))
+        for _ in range(8)
+    ]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert all(r == data for r in results)
+    full_gets = [k for k in gets if k == block_key(41, 0, 65536)]
+    assert len(full_gets) == 1  # deduped by singleflight

@@ -516,3 +516,58 @@ def test_hardlink_parent_tracking(m):
     assert parents == {d1: 1, d2: 2}
     m.unlink(CTX, d2, b"l1")
     assert m.get_parents(ino) == {d1: 1, d2: 1}
+
+
+def test_setattr_size_truncates(m):
+    from juicefs_tpu.meta.types import SET_ATTR_SIZE
+
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"ss", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=8192, off=0, len=8192))
+    st, attr = m.setattr(CTX, ino, SET_ATTR_SIZE, Attr(length=100))
+    assert st == 0 and attr.length == 100
+    m.close(CTX, ino)
+
+
+def test_deep_tree_rmr_and_summary(m):
+    parent = ROOT_INODE
+    for i in range(600):  # deeper than Python's default recursion limit / 2
+        st, parent, _ = m.mkdir(CTX, parent, b"d", 0o755)
+        assert st == 0
+    st, s = m.summary(CTX, ROOT_INODE)
+    assert st == 0 and s.dirs >= 601
+    st, n = m.remove_recursive(CTX, ROOT_INODE, b"d", skip_trash=True)
+    assert st == 0 and n == 600
+
+
+def test_trash_parent_updated(tmp_path):
+    """Trashed inode's parent must point at the trash hour dir."""
+    c = new_client(f"sqlite3://{tmp_path}/tp.db")
+    c.init(Format(name="tp", trash_days=1), force=True)
+    c.load()
+    c.new_session()
+    _, ino, _ = c.create(CTX, ROOT_INODE, b"f", 0o644)
+    c.close(CTX, ino)
+    assert c.unlink(CTX, ROOT_INODE, b"f") == 0
+    _, attr = c.getattr(CTX, ino)
+    assert attr.parent > TRASH_INODE  # hour dir, not old parent
+    c.close_session()
+
+
+def test_notifications_fire_after_commit(m):
+    """DELETE_SLICE callbacks observe committed metadata state."""
+    states = []
+
+    def on_delete(sid, size):
+        # at callback time the chunk key must already be gone
+        st, slices = m.do_read_chunk(probe_ino, 0)
+        states.append([s.id for s in slices])
+
+    _, probe_ino, _ = m.create(CTX, ROOT_INODE, b"nf", 0o644)
+    sid = m.new_slice()
+    m.write_chunk(probe_ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0, len=4096))
+    m.on_msg(meta_interface.DELETE_SLICE, on_delete)
+    m.close(CTX, probe_ino)
+    m.unlink(CTX, ROOT_INODE, b"nf")
+    m.cleanup_deleted_files()
+    assert states == [[]]
