@@ -1,0 +1,237 @@
+"""Batched JTH-256 on TPU: XLA (jnp/lax.scan) and Pallas implementations.
+
+Both compute the exact spec in jth256.py and must produce byte-identical
+digests to the numpy reference (BASELINE.md acceptance bar). The work per
+row step is a ~6-op uint32 ARX chain over a (B*M*128)-wide vector, so the
+kernel is HBM-bandwidth bound: each 64 KiB lane is read once. The XLA path
+expresses the 128-row chain as lax.scan (static trip count, fuses into one
+loop); the Pallas path keeps a whole lane tile in VMEM and unrolls the row
+loop, double-buffered across the grid by the Pallas pipeline.
+
+Shapes are static: callers pad batches to (B, M, 128, 128) via
+jth256.pack_blocks, so each (B, M) pair compiles once and is cached.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .jth256 import (
+    COLS,
+    IV,
+    ROWS,
+    digests_to_bytes,
+    pack_blocks,
+)
+from . import jth256 as _spec
+
+_P1 = jnp.uint32(0x9E3779B1)
+_P2 = jnp.uint32(0x85EBCA77)
+_P3 = jnp.uint32(0xC2B2AE3D)
+_P4 = jnp.uint32(0x27D4EB2F)
+_P5 = jnp.uint32(0x165667B1)
+_FM1 = jnp.uint32(0x85EBCA6B)
+_FM2 = jnp.uint32(0xC2B2AE35)
+
+
+def _rotl(x, k: int):
+    return (x << jnp.uint32(k)) | (x >> jnp.uint32(32 - k))
+
+
+def _fmix(x):
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * _FM1
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * _FM2
+    return x ^ (x >> jnp.uint32(16))
+
+
+def _row_chain_scan(words: jax.Array, s0: jax.Array) -> jax.Array:
+    """128-row mixing chain via lax.scan. words (B,M,128,128), s0 (B,M,128)."""
+
+    def step(s, w):
+        s = (s ^ w) * _P1
+        s = _rotl(s, 13) * _P2
+        s = s ^ (s >> jnp.uint32(15))
+        return s, None
+
+    s, _ = lax.scan(step, s0, jnp.moveaxis(words, 2, 0))
+    return s
+
+
+def _lane_states(words: jax.Array, lane_offset=0) -> jax.Array:
+    """Initial row-chain states. lane_offset shifts the per-lane tweak so a
+    lane-sharded device computes with its *global* lane indices."""
+    b, m = words.shape[0], words.shape[1]
+    j = jnp.arange(COLS, dtype=jnp.uint32)
+    lanes = jnp.arange(m, dtype=jnp.uint32) + jnp.uint32(lane_offset)
+    s0 = _P5 ^ (j * _P1)[None, None, :] ^ (lanes * _P3)[None, :, None]
+    return jnp.broadcast_to(s0, (b, m, COLS))
+
+
+def _lane_accs(s: jax.Array, lane_offset=0) -> jax.Array:
+    """Fold lane states (B,M,128) -> per-lane digests (B,M,8)."""
+    b, m = s.shape[0], s.shape[1]
+    lanes = jnp.arange(m, dtype=jnp.uint32) + jnp.uint32(lane_offset)
+    k8 = jnp.arange(8, dtype=jnp.uint32)
+    g = s.reshape(b, m, 16, 8)
+    acc = jnp.broadcast_to(
+        _P4 ^ (lanes * _P2)[None, :, None] ^ (k8 * _P1)[None, None, :],
+        (b, m, 8),
+    )
+    for gi in range(16):
+        acc = _rotl((acc ^ g[:, :, gi, :]) * _P3, 11) + jnp.uint32(gi) * _P5
+    return acc
+
+
+def _combine_accs(
+    acc: jax.Array, lane_counts: jax.Array, lengths: jax.Array
+) -> jax.Array:
+    """Sequentially combine per-lane digests (B,M,8) -> digests (B,8)."""
+    b, m = acc.shape[0], acc.shape[1]
+    lanes = jnp.arange(m, dtype=jnp.uint32)
+    k8 = jnp.arange(8, dtype=jnp.uint32)
+    h0 = jnp.broadcast_to(jnp.asarray(IV, dtype=jnp.uint32), (b, 8))
+    counts = lane_counts.astype(jnp.uint32)
+
+    def lane_step(h, inp):
+        d, li = inp
+        hn = _rotl((h ^ d) * _P2, 17) + li * _P1
+        live = (counts > li)[:, None]
+        return jnp.where(live, hn, h), None
+
+    h, _ = lax.scan(lane_step, h0, (jnp.moveaxis(acc, 1, 0), lanes))
+    h = h ^ (lengths.astype(jnp.uint32)[:, None] + k8[None, :] * _P4)
+    return _fmix(h)
+
+
+def _finish(
+    s: jax.Array, lane_counts: jax.Array, lengths: jax.Array
+) -> jax.Array:
+    """Fold lane states (B,M,128) -> digests (B,8), per the spec."""
+    return _combine_accs(_lane_accs(s), lane_counts, lengths)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def hash_packed_jax(
+    words: jax.Array, lane_counts: jax.Array, lengths: jax.Array
+) -> jax.Array:
+    """XLA path: (B, M, 128, 128) uint32 -> (B, 8) uint32 digests."""
+    return _finish(_row_chain_scan(words, _lane_states(words)), lane_counts, lengths)
+
+
+# ---------------------------------------------------------------------------
+# Pallas path: one grid step = one lane tile resident in VMEM.
+# ---------------------------------------------------------------------------
+
+_LANE_GROUP = 8  # lanes per grid step; makes the (8,128) output block tileable
+
+
+def _pallas_row_chain(words_flat: jax.Array, m: int, unroll: int = 8) -> jax.Array:
+    """words_flat (L, 128, 128) -> lane states (L, 128); L = B*M lanes.
+
+    One grid step keeps 8 lane tiles (8 x 64 KiB) resident in VMEM and runs
+    their row chains together; the Pallas pipeline double-buffers the
+    HBM->VMEM streaming across grid steps.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(w_ref, out_ref):
+        # Constants are rebuilt from Python ints here: a pallas kernel may
+        # not close over device arrays created outside the trace.
+        p1, p2, p3, p5 = (
+            jnp.uint32(0x9E3779B1),
+            jnp.uint32(0x85EBCA77),
+            jnp.uint32(0xC2B2AE3D),
+            jnp.uint32(0x165667B1),
+        )
+        i = pl.program_id(0)
+        u8 = jax.lax.broadcasted_iota(jnp.uint32, (_LANE_GROUP, 1), 0)
+        lane = jax.lax.rem(jnp.uint32(i * _LANE_GROUP) + u8, jnp.uint32(m))
+        j = jax.lax.broadcasted_iota(jnp.uint32, (_LANE_GROUP, COLS), 1)
+        s = p5 ^ (j * p1) ^ (lane * p3)
+
+        def body(r, s):
+            for u in range(unroll):
+                w = w_ref[:, r * unroll + u, :]
+                s = (s ^ w) * p1
+                s = ((s << jnp.uint32(13)) | (s >> jnp.uint32(19))) * p2
+                s = s ^ (s >> jnp.uint32(15))
+            return s
+
+        out_ref[:, :] = jax.lax.fori_loop(0, ROWS // unroll, body, s)
+
+    n_lanes = words_flat.shape[0]
+    padded = -(-n_lanes // _LANE_GROUP) * _LANE_GROUP
+    if padded != n_lanes:
+        # Pad with zero lanes; their states are computed and discarded.
+        words_flat = jnp.concatenate(
+            [words_flat, jnp.zeros((padded - n_lanes, ROWS, COLS), jnp.uint32)]
+        )
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((padded, COLS), jnp.uint32),
+        grid=(padded // _LANE_GROUP,),
+        in_specs=[
+            pl.BlockSpec(
+                (_LANE_GROUP, ROWS, COLS),
+                lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec((_LANE_GROUP, COLS), lambda i: (i, 0)),
+        interpret=interpret,
+    )(words_flat)
+    return out[:n_lanes]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def hash_packed_pallas(
+    words: jax.Array, lane_counts: jax.Array, lengths: jax.Array
+) -> jax.Array:
+    b, m = words.shape[0], words.shape[1]
+    s = _pallas_row_chain(words.reshape(b * m, ROWS, COLS), m).reshape(b, m, COLS)
+    return _finish(s, lane_counts, lengths)
+
+
+_IMPLS = {"xla": hash_packed_jax, "pallas": hash_packed_pallas}
+
+
+def make_hash_fn(impl: str = "xla"):
+    """Return the jitted (words, lane_counts, lengths) -> (B,8) hash fn."""
+    try:
+        return _IMPLS[impl]
+    except KeyError:
+        raise ValueError(f"unknown hash impl {impl!r} (want xla|pallas)") from None
+
+
+def hash_blocks_jax(
+    blocks, impl: str = "xla", pad_lanes: int | None = None
+) -> list[bytes]:
+    """Hash a batch of bytes blocks on the default JAX backend."""
+    blocks = list(blocks)
+    if not blocks:
+        return []
+    words, counts, lengths = pack_blocks(blocks, pad_lanes=pad_lanes)
+    fn = make_hash_fn(impl)
+    out = np.asarray(jax.device_get(fn(words, counts, lengths)))
+    return digests_to_bytes(out)
+
+
+def verify_backend(impl: str = "xla", seed: int = 0) -> bool:
+    """Self-check: device digests byte-identical to the numpy reference."""
+    rng = np.random.default_rng(seed)
+    blocks = [
+        rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        for n in (0, 1, 100, _spec.LANE_BYTES, _spec.LANE_BYTES + 7, 3 * _spec.LANE_BYTES)
+    ]
+    dev = hash_blocks_jax(blocks, impl=impl)
+    ref = [_spec.jth256(b) for b in blocks]
+    return dev == ref

@@ -1,0 +1,92 @@
+"""Streaming host->device hash pipeline (SURVEY.md §7 stage 4).
+
+Feeds block bytes from the chunk/object layer to the device in fixed-shape
+batches and returns (key, digest) pairs. Mirrors the role of the reference's
+async per-block upload/load pools (pkg/chunk/cached_store.go:415-472) but as
+a double-buffered device pipeline: JAX dispatch is async, so packing batch
+k+1 on the host overlaps hashing batch k on the TPU; results are only
+blocked on one batch behind.
+
+Backend selection mirrors the reference's Compressor registry pattern
+(pkg/compress/compress.go:31-49): "cpu" (vectorized numpy), "xla", "pallas".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from .jth256 import (
+    BLOCK_BYTES,
+    LANE_BYTES,
+    digests_to_bytes,
+    hash_packed_np,
+    pack_blocks,
+)
+
+
+@dataclass
+class PipelineConfig:
+    backend: str = "xla"  # cpu | xla | pallas
+    batch_blocks: int = 32
+    # Pad every batch to this many lanes so one compiled program serves the
+    # whole stream (4 MiB default block = 64 lanes).
+    pad_lanes: int = BLOCK_BYTES // LANE_BYTES
+
+
+class HashPipeline:
+    """hash_stream(iter[(key, bytes)]) -> iter[(key, 32-byte digest)]."""
+
+    def __init__(self, config: Optional[PipelineConfig] = None):
+        self.config = config or PipelineConfig()
+        self._fn = None
+        if self.config.backend != "cpu":
+            from .hash_jax import make_hash_fn
+
+            self._fn = make_hash_fn(self.config.backend)
+
+    def _hash_packed(self, words, counts, lengths):
+        if self._fn is None:
+            return hash_packed_np(words, counts, lengths)
+        return self._fn(words, counts, lengths)
+
+    def hash_stream(
+        self, items: Iterable[tuple[str, bytes]]
+    ) -> Iterator[tuple[str, bytes]]:
+        cfg = self.config
+        pending: list[tuple[list[str], object]] = []
+        keys: list[str] = []
+        blocks: list[bytes] = []
+
+        def dispatch():
+            nonlocal keys, blocks
+            if not blocks:
+                return
+            words, counts, lengths = pack_blocks(blocks, pad_lanes=cfg.pad_lanes)
+            pending.append((keys, self._hash_packed(words, counts, lengths)))
+            keys, blocks = [], []
+
+        def drain(batch) -> Iterator[tuple[str, bytes]]:
+            bkeys, out = batch
+            digests = digests_to_bytes(np.asarray(out))
+            return zip(bkeys, digests[: len(bkeys)])
+
+        for key, data in items:
+            if len(data) > cfg.pad_lanes * LANE_BYTES:
+                raise ValueError(f"block {key} larger than pipeline pad size")
+            keys.append(key)
+            blocks.append(data)
+            if len(blocks) >= cfg.batch_blocks:
+                dispatch()
+                # Keep exactly one batch in flight: async dispatch means the
+                # device hashes batch k while the host packs batch k+1.
+                while len(pending) > 1:
+                    yield from drain(pending.pop(0))
+        dispatch()
+        while pending:
+            yield from drain(pending.pop(0))
+
+    def hash_blocks(self, blocks: Iterable[bytes]) -> list[bytes]:
+        return [d for _, d in self.hash_stream((str(i), b) for i, b in enumerate(blocks))]

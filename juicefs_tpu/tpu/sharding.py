@@ -1,0 +1,87 @@
+"""Multi-chip sharding for the scan pipeline (SURVEY.md §2.3, §7).
+
+The reference scales its scans with goroutine pools on one host and an
+ssh-launched manager/worker cluster for sync (pkg/sync/cluster.go:132,237).
+The TPU-native equivalent is SPMD over a jax.sharding.Mesh with two axes:
+
+  data — blocks of the batch (the DP analog): embarrassingly parallel,
+         no communication until the final dedup, which all_gathers only
+         32-byte digests (not block data) over ICI.
+  lane — 64 KiB lanes *within* a block (the SP/sequence-parallel analog):
+         the heavy row chains run sharded, then an all_gather of the tiny
+         per-lane digests (B x M x 8 words) precedes the short sequential
+         combine, which every device replays identically.
+
+So the bytes that cross ICI are ~1/2048th of the bytes hashed; the design
+follows the scaling-book recipe: annotate shardings, let XLA insert the
+collectives, keep them on ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .dedup import dedup_scan_jax
+from .hash_jax import _combine_accs, _lane_accs, _lane_states, _row_chain_scan
+
+
+def make_mesh(
+    n_data: int | None = None, n_lane: int = 1, devices=None
+) -> Mesh:
+    """Build a (data, lane) mesh over the given (default: all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_lane
+    used = n_data * n_lane
+    if used > len(devices):
+        raise ValueError(f"mesh {n_data}x{n_lane} needs {used} devices, have {len(devices)}")
+    arr = np.array(devices[:used]).reshape(n_data, n_lane)
+    return Mesh(arr, ("data", "lane"))
+
+
+def sharded_scan_step(mesh: Mesh):
+    """Compile the full multi-chip scan step over `mesh`.
+
+    Returns a jitted fn (words (B,M,128,128), lane_counts (B,), lengths (B,))
+    -> (digests (B,8), dup_mask (B,), first_idx (B,)); B must divide by the
+    data axis and M by the lane axis. Outputs are fully replicated.
+    """
+    n_lane = mesh.shape["lane"]
+
+    def step(words, lane_counts, lengths):
+        local_m = words.shape[1]
+        loff = lax.axis_index("lane") * local_m
+        s = _row_chain_scan(words, _lane_states(words, loff))
+        acc = _lane_accs(s, loff)
+        # Gather tiny per-lane digests across the lane axis; each device
+        # then replays the short sequential combine on full lane order.
+        acc = lax.all_gather(acc, "lane", axis=1, tiled=True)
+        digests = _combine_accs(acc, lane_counts, lengths)
+        # Dedup needs the global digest set: gather across data (32 B/block).
+        all_digests = lax.all_gather(digests, "data", axis=0, tiled=True)
+        dup, first = dedup_scan_jax(all_digests)
+        return all_digests, dup, first
+
+    mapped = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("data", "lane", None, None), P("data"), P("data")),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def shard_batch(mesh: Mesh, words, lane_counts, lengths):
+    """Device_put a packed batch with the scan step's input shardings."""
+    ws = NamedSharding(mesh, P("data", "lane", None, None))
+    bs = NamedSharding(mesh, P("data"))
+    return (
+        jax.device_put(words, ws),
+        jax.device_put(lane_counts, bs),
+        jax.device_put(lengths, bs),
+    )

@@ -1,15 +1,28 @@
 """Test harness: force JAX onto a virtual 8-device CPU mesh so sharding
 paths are exercised hermetically (multi-chip TPU hardware is validated
-separately by __graft_entry__.dryrun_multichip)."""
+separately by __graft_entry__.dryrun_multichip).
+
+Set JFS_TEST_REAL_TPU=1 to run the suite against the real accelerator
+instead (sharded-mesh tests then skip if fewer than 8 devices exist).
+"""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if not os.environ.get("JFS_TEST_REAL_TPU"):
+    # Hard-set (not setdefault): the ambient environment may point JAX at a
+    # real TPU tunnel, but unit tests must be hermetic and multi-device.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # A sitecustomize hook may have registered a TPU plugin at interpreter
+    # startup and pinned jax_platforms past the env var; override the
+    # config itself (jax backends are not initialized yet at conftest time).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
