@@ -137,6 +137,27 @@ class CachedStore:
                 pass
 
     # -- public API (reference chunk.go:37-46 ChunkStore) ------------------
+    def _block_range(self, sid: int, length: int, off: int = 0, size: int | None = None):
+        """Yield (key, bsize) for every block of slice `sid` covering
+        [off, off+size) (default: the whole slice). Zero-length slices yield
+        their single empty block."""
+        bs = self.conf.block_size
+        if length <= 0:
+            yield block_key(sid, 0, 0), 0
+            return
+        end = length if size is None else min(length, off + size)
+        for indx in range(off // bs, (end + bs - 1) // bs):
+            bsize = min(bs, length - indx * bs)
+            if bsize > 0:
+                yield block_key(sid, indx, bsize), bsize
+
+    def prefetch(self, sid: int, length: int, off: int = 0, size: int | None = None) -> None:
+        """Warm the blocks of slice `sid` covering [off, off+size) via the
+        prefetch pool (used by the VFS readahead; reference prefetch.go)."""
+        for key, bsize in self._block_range(sid, length, off, size):
+            if bsize > 0:
+                self._fetcher.fetch((key, bsize))
+
     def new_writer(self, sid: int) -> "WSlice":
         return WSlice(self, sid)
 
@@ -144,10 +165,7 @@ class CachedStore:
         return RSlice(self, sid, length)
 
     def remove(self, sid: int, length: int) -> None:
-        bs = self.conf.block_size
-        for indx in range((length + bs - 1) // bs or 1):
-            bsize = min(bs, length - indx * bs) if length else 0
-            key = block_key(sid, indx, bsize)
+        for key, _ in self._block_range(sid, length):
             self.cache.remove(key)
             with self._pending_lock:
                 self._pending_staged.pop(key, None)
@@ -158,26 +176,23 @@ class CachedStore:
 
     def fill_cache(self, sid: int, length: int) -> None:
         """Warm every block of a slice (reference vfs/fill.go FillCache)."""
-        bs = self.conf.block_size
-        for indx in range((length + bs - 1) // bs):
-            bsize = min(bs, length - indx * bs)
-            self._load_block(block_key(sid, indx, bsize), bsize)
+        if length > 0:
+            for key, bsize in self._block_range(sid, length):
+                self._load_block(key, bsize)
 
     def check_cache(self, sid: int, length: int) -> int:
         """Number of cached blocks for a slice."""
-        bs = self.conf.block_size
-        n = 0
-        for indx in range((length + bs - 1) // bs):
-            bsize = min(bs, length - indx * bs)
-            if self.cache.load(block_key(sid, indx, bsize)) is not None:
-                n += 1
-        return n
+        if length <= 0:
+            return 0
+        return sum(
+            1 for key, _ in self._block_range(sid, length)
+            if self.cache.load(key) is not None
+        )
 
     def evict_cache(self, sid: int, length: int) -> None:
-        bs = self.conf.block_size
-        for indx in range((length + bs - 1) // bs):
-            bsize = min(bs, length - indx * bs)
-            self.cache.remove(block_key(sid, indx, bsize))
+        if length > 0:
+            for key, _ in self._block_range(sid, length):
+                self.cache.remove(key)
 
     def flush_all(self, timeout: float = 60.0) -> None:
         """Drain pending writeback uploads (used by fsync paths and tests)."""

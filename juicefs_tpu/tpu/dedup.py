@@ -31,6 +31,8 @@ def dedup_scan_jax(digests: jax.Array):
     (i itself when unique or first occurrence).
     """
     n = digests.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), dtype=bool), jnp.zeros((0,), dtype=jnp.int32)
     idx = jnp.arange(n, dtype=jnp.int32)
     cols = [digests[:, k] for k in range(8)]
     # Tie-break on original index so each group is ordered by appearance.

@@ -1,0 +1,353 @@
+"""VFS core: the filesystem every presentation adapter serves.
+
+Port of the reference's pkg/vfs/vfs.go surface (vfs.go:155-1157): FUSE, the
+S3 gateway, WebDAV, and the SDK all call these methods. Namespace/attr ops
+delegate to the metadata engine; file data flows through DataReader /
+DataWriter over the chunk store; the handle table binds kernel fds to open
+state. Key consistency behaviors preserved from the reference:
+
+  - reads flush overlapping buffered writes first (vfs.go:651 Read calls
+    writer flush), so a process always reads its own writes;
+  - truncate/fallocate flush the target file before mutating length
+    (vfs.go:867-947), and open writers learn the new length;
+  - O_APPEND writes land at the current (buffered) end of file;
+  - release waits out in-flight ops, flushes, then drops the handle.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import os
+import threading
+from dataclasses import dataclass, field, replace
+
+from ..chunk import CachedStore
+from ..meta.base import BaseMeta
+from ..meta.context import Context
+from ..meta.types import (
+    Attr,
+    CHUNK_SIZE,
+    Entry,
+    Format,
+    SET_ATTR_SIZE,
+    TYPE_DIRECTORY,
+    TYPE_FILE,
+)
+from ..utils import get_logger
+from .handles import Handle, HandleTable
+from .reader import DataReader
+from .writer import DataWriter
+
+logger = get_logger("vfs")
+
+ROOT_INO = 1
+MAX_FILE_SIZE = CHUNK_SIZE << 31  # cap file length like the reference
+MAX_SYMLINK = 4096
+
+
+@dataclass
+class VFSConfig:
+    readonly: bool = False
+    max_readahead: int = 8 << 20
+    attr_timeout: float = 1.0
+    entry_timeout: float = 1.0
+    dir_entry_timeout: float = 1.0
+    hide_internal: bool = False
+    extra: dict = field(default_factory=dict)
+
+
+class VFS:
+    def __init__(
+        self,
+        meta: BaseMeta,
+        store: CachedStore,
+        conf: VFSConfig | None = None,
+        fmt: Format | None = None,
+    ):
+        self.meta = meta
+        self.store = store
+        self.conf = conf or VFSConfig()
+        self.fmt = fmt
+        self.handles = HandleTable()
+        self.writer = DataWriter(meta, store)
+        self.reader = DataReader(meta, store, self.conf.max_readahead, writer=self.writer)
+        self._append_lock = threading.Lock()
+
+    # -- namespace ---------------------------------------------------------
+
+    def lookup(self, ctx: Context, parent: int, name: bytes) -> tuple[int, int, Attr]:
+        return self.meta.lookup(ctx, parent, name)
+
+    def getattr(self, ctx: Context, ino: int) -> tuple[int, Attr]:
+        st, attr = self.meta.getattr(ctx, ino)
+        if st == 0 and attr.typ == TYPE_FILE:
+            # Surface buffered writes in stat (reference UpdateLength). Copy
+            # first: meta may have handed us its cached Attr instance, and
+            # mutating it would poison the open-file cache.
+            wlen = self.writer.get_length(ino)
+            if wlen is not None and wlen > attr.length:
+                attr = replace(attr)
+                attr.length = wlen
+        return st, attr
+
+    def setattr(self, ctx: Context, ino: int, flags: int, attr: Attr) -> tuple[int, Attr]:
+        if self.conf.readonly:
+            return _errno.EROFS, Attr()
+        if flags & SET_ATTR_SIZE:
+            if attr.length > MAX_FILE_SIZE:
+                return _errno.EFBIG, Attr()
+            st = self.writer.flush(ino)
+            if st != 0:
+                return st, Attr()
+        st, out = self.meta.setattr(ctx, ino, flags, attr)
+        if st == 0 and flags & SET_ATTR_SIZE:
+            self.writer.truncate(ino, out.length)
+        return st, out
+
+    def mknod(self, ctx, parent, name, mode, cumask=0, rdev=0) -> tuple[int, int, Attr]:
+        if self.conf.readonly:
+            return _errno.EROFS, 0, Attr()
+        return self.meta.mknod(ctx, parent, name, TYPE_FILE, mode, cumask, rdev)
+
+    def mkdir(self, ctx, parent, name, mode, cumask=0) -> tuple[int, int, Attr]:
+        if self.conf.readonly:
+            return _errno.EROFS, 0, Attr()
+        return self.meta.mkdir(ctx, parent, name, mode, cumask)
+
+    def symlink(self, ctx, parent, name, target: bytes) -> tuple[int, int, Attr]:
+        if self.conf.readonly:
+            return _errno.EROFS, 0, Attr()
+        if len(target) >= MAX_SYMLINK:
+            return _errno.ENAMETOOLONG, 0, Attr()
+        return self.meta.symlink(ctx, parent, name, target)
+
+    def readlink(self, ctx, ino) -> tuple[int, bytes]:
+        return self.meta.readlink(ctx, ino)
+
+    def unlink(self, ctx, parent, name) -> int:
+        if self.conf.readonly:
+            return _errno.EROFS
+        return self.meta.unlink(ctx, parent, name)
+
+    def rmdir(self, ctx, parent, name) -> int:
+        if self.conf.readonly:
+            return _errno.EROFS
+        return self.meta.rmdir(ctx, parent, name)
+
+    def rename(self, ctx, psrc, nsrc, pdst, ndst, flags=0) -> tuple[int, int, Attr]:
+        if self.conf.readonly:
+            return _errno.EROFS, 0, Attr()
+        return self.meta.rename(ctx, psrc, nsrc, pdst, ndst, flags)
+
+    def link(self, ctx, ino, parent, name) -> tuple[int, Attr]:
+        if self.conf.readonly:
+            return _errno.EROFS, Attr()
+        st = self.writer.flush(ino)
+        if st != 0:
+            return st, Attr()
+        return self.meta.link(ctx, ino, parent, name)
+
+    # -- directories -------------------------------------------------------
+
+    def opendir(self, ctx: Context, ino: int) -> tuple[int, int]:
+        st, attr = self.meta.getattr(ctx, ino)
+        if st != 0:
+            return st, 0
+        if attr.typ != TYPE_DIRECTORY:
+            return _errno.ENOTDIR, 0
+        h = self.handles.new(ino)
+        return 0, h.fh
+
+    def readdir(
+        self, ctx: Context, ino: int, fh: int, offset: int, want_attr: bool = False
+    ) -> tuple[int, list[Entry]]:
+        h = self.handles.get(fh)
+        if h is None:
+            return _errno.EBADF, []
+        if h.children is None or offset == 0:
+            st, entries = self.meta.readdir(ctx, ino, want_attr)
+            if st != 0:
+                return st, []
+            h.children = entries
+        return 0, h.children[offset:]
+
+    def releasedir(self, ctx: Context, fh: int) -> int:
+        self.handles.remove(fh)
+        return 0
+
+    # -- files -------------------------------------------------------------
+
+    def create(
+        self, ctx: Context, parent: int, name: bytes, mode: int, cumask: int = 0,
+        flags: int = os.O_RDWR,
+    ) -> tuple[int, int, Attr, int]:
+        if self.conf.readonly:
+            return _errno.EROFS, 0, Attr(), 0
+        st, ino, attr = self.meta.create(ctx, parent, name, mode, cumask, flags)
+        if st != 0:
+            return st, 0, Attr(), 0
+        fh = self._new_file_handle(ino, attr.length, flags)
+        return 0, ino, attr, fh
+
+    def open(self, ctx: Context, ino: int, flags: int) -> tuple[int, Attr, int]:
+        accmode = flags & os.O_ACCMODE
+        if self.conf.readonly and (
+            accmode != os.O_RDONLY or flags & (os.O_TRUNC | os.O_APPEND)
+        ):
+            return _errno.EROFS, Attr(), 0
+        st, attr = self.meta.open(ctx, ino, flags)
+        if st != 0:
+            return st, Attr(), 0
+        if flags & os.O_TRUNC:
+            st, attr = self.truncate_ino(ctx, ino, 0)
+            if st != 0:
+                self.meta.close(ctx, ino)
+                return st, Attr(), 0
+        fh = self._new_file_handle(ino, attr.length, flags)
+        return 0, attr, fh
+
+    def _new_file_handle(self, ino: int, length: int, flags: int) -> int:
+        h = self.handles.new(ino, flags)
+        accmode = flags & os.O_ACCMODE
+        if accmode in (os.O_RDONLY, os.O_RDWR):
+            h.reader = self.reader.open(ino)
+        if accmode in (os.O_WRONLY, os.O_RDWR):
+            h.writer = self.writer.open(ino, length)
+        return h.fh
+
+    def read(self, ctx: Context, ino: int, fh: int, off: int, size: int) -> tuple[int, bytes]:
+        h = self.handles.get(fh)
+        if h is None or h.ino != ino:
+            return _errno.EBADF, b""
+        if h.reader is None:
+            return _errno.EACCES, b""
+        if off >= MAX_FILE_SIZE or size > (64 << 20):
+            return _errno.EFBIG, b""
+        # Read-after-write consistency: push buffered writes down first,
+        # but only when they overlap the read range (avoids slice churn
+        # in interleaved write/read workloads).
+        fw = self.writer.find(ino)
+        if fw is not None:
+            st = fw.flush_if_overlaps(off, size)
+            if st != 0:
+                return st, b""
+        h.begin_read()
+        try:
+            return h.reader.read(ctx, off, size)
+        finally:
+            h.end_read()
+
+    def write(self, ctx: Context, ino: int, fh: int, off: int, data: bytes) -> int:
+        h = self.handles.get(fh)
+        if h is None or h.ino != ino:
+            return _errno.EBADF
+        if h.writer is None:
+            return _errno.EACCES
+        if off + len(data) > MAX_FILE_SIZE:
+            return _errno.EFBIG
+        h.begin_write()
+        try:
+            if h.flags & os.O_APPEND:
+                with self._append_lock:
+                    st, attr = self.getattr(ctx, ino)
+                    if st != 0:
+                        return st
+                    return h.writer.write(attr.length, data)
+            return h.writer.write(off, data)
+        finally:
+            h.end_write()
+
+    def flush(self, ctx: Context, ino: int, fh: int, lock_owner: int = 0) -> int:
+        h = self.handles.get(fh)
+        if h is None:
+            return _errno.EBADF
+        if h.writer is not None:
+            st = h.writer.flush()
+            if st != 0:
+                return st
+        # Drop this owner's POSIX locks on close, per POSIX close(2).
+        if lock_owner and hasattr(self.meta, "setlk"):
+            self.meta.setlk(
+                ctx, ino, lock_owner, self.meta.F_UNLCK, 0, 0x7FFFFFFFFFFFFFFF
+            )
+        return 0
+
+    def fsync(self, ctx: Context, ino: int, fh: int) -> int:
+        return self.flush(ctx, ino, fh)
+
+    def release(self, ctx: Context, ino: int, fh: int) -> int:
+        h = self.handles.remove(fh)
+        if h is None:
+            return 0
+        h.wait_quiet()
+        st = 0
+        if h.writer is not None:
+            st = self.writer.close(ino)
+        self.meta.close(ctx, ino)
+        return st
+
+    # -- data shaping ------------------------------------------------------
+
+    def truncate_ino(self, ctx: Context, ino: int, length: int) -> tuple[int, Attr]:
+        st = self.writer.flush(ino)
+        if st != 0:
+            return st, Attr()
+        st, attr = self.meta.truncate(ctx, ino, length)
+        if st == 0:
+            self.writer.truncate(ino, length)
+        return st, attr
+
+    def fallocate(self, ctx: Context, ino: int, fh: int, mode: int, off: int, size: int) -> int:
+        if self.conf.readonly:
+            return _errno.EROFS
+        h = self.handles.get(fh)
+        if h is None or h.writer is None:
+            return _errno.EBADF
+        if off + size > MAX_FILE_SIZE:
+            return _errno.EFBIG
+        st = self.writer.flush(ino)
+        if st != 0:
+            return st
+        return self.meta.fallocate(ctx, ino, mode, off, size)
+
+    def copy_file_range(
+        self, ctx: Context, fin: int, off_in: int, fout: int, off_out: int,
+        size: int, flags: int = 0,
+    ) -> tuple[int, int]:
+        if self.conf.readonly:
+            return _errno.EROFS, 0
+        for ino in (fin, fout):
+            st = self.writer.flush(ino)
+            if st != 0:
+                return st, 0
+        return self.meta.copy_file_range(ctx, fin, off_in, fout, off_out, size, flags)
+
+    # -- xattr / statfs ----------------------------------------------------
+
+    def getxattr(self, ctx, ino, name) -> tuple[int, bytes]:
+        return self.meta.getxattr(ctx, ino, name)
+
+    def setxattr(self, ctx, ino, name, value, flags=0) -> int:
+        if self.conf.readonly:
+            return _errno.EROFS
+        return self.meta.setxattr(ctx, ino, name, value, flags)
+
+    def listxattr(self, ctx, ino) -> tuple[int, list[bytes]]:
+        return self.meta.listxattr(ctx, ino)
+
+    def removexattr(self, ctx, ino, name) -> int:
+        if self.conf.readonly:
+            return _errno.EROFS
+        return self.meta.removexattr(ctx, ino, name)
+
+    def statfs(self, ctx) -> tuple[int, int, int, int]:
+        return self.meta.statfs(ctx)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def flush_all(self) -> int:
+        return self.writer.flush_all()
+
+    def close(self) -> None:
+        self.writer.close_all()
+        self.store.flush_all()
