@@ -1,0 +1,113 @@
+"""CLI driver (reference: cmd/, SURVEY.md §2.1).
+
+`python -m juicefs_tpu.cmd <command>` mirrors the reference's 27-subcommand
+urfave/cli app (cmd/main.go:61-89). Commands register in COMMANDS; each
+module exposes `add_parser(sub)` and a `run(args)`.
+
+Shared plumbing here: open the meta client, load the volume Format, build
+the object store with its wrappers (prefix/shard/encrypt — reference
+cmd/mount.go:387 NewReloadableStorage), and assemble the chunk store/VFS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..chunk import CachedStore, ChunkConfig
+from ..meta import new_client
+from ..meta.types import Format
+from ..object import create_storage, sharded, with_prefix
+from ..utils import get_logger
+
+logger = get_logger("cmd")
+
+
+def open_meta(addr: str, **kw):
+    m = new_client(addr, **kw)
+    fmt = m.load()
+    return m, fmt
+
+
+def storage_for(fmt: Format):
+    """Build the blob store stack from a volume Format (reference
+    cmd/mount.go:387 + pkg/object wrappers)."""
+    bucket = fmt.bucket or ""
+    scheme = fmt.storage or "file"
+    if fmt.shards > 1:
+        stores = [
+            create_storage(f"{scheme}://{bucket}{i:02d}") for i in range(fmt.shards)
+        ]
+        store = sharded(stores)
+    else:
+        uri = f"{scheme}://{bucket}" if "://" not in bucket else bucket
+        store = create_storage(uri)
+    # Keep volume objects namespaced like the reference ({name}/ prefix)
+    if scheme not in ("mem",):
+        store = with_prefix(store, fmt.name + "/")
+    if fmt.encrypt_key:
+        from ..object import new_encrypted
+
+        store = new_encrypted(store, fmt.encrypt_key.encode())
+    return store
+
+
+def chunk_conf(fmt: Format, args=None) -> ChunkConfig:
+    cache_dirs = ("memory",)
+    writeback = False
+    if args is not None:
+        if getattr(args, "cache_dir", None):
+            cache_dirs = tuple(str(args.cache_dir).split(":"))
+        writeback = bool(getattr(args, "writeback", False))
+    conf = ChunkConfig(
+        block_size=fmt.block_size * 1024,
+        compress=fmt.compression,
+        cache_dirs=cache_dirs,
+        writeback=writeback,
+    )
+    if getattr(args, "cache_size", None):
+        conf.cache_size = int(args.cache_size) << 20
+    return conf
+
+
+def build_store(fmt: Format, args=None) -> CachedStore:
+    return CachedStore(storage_for(fmt), chunk_conf(fmt, args))
+
+
+def main(argv: list[str] | None = None) -> int:
+    from . import (
+        bench,
+        dump,
+        format as format_cmd,
+        fsck,
+        gc,
+        info,
+        mount,
+        objbench,
+        sync,
+        warmup,
+    )
+
+    parser = argparse.ArgumentParser(
+        prog="juicefs-tpu",
+        description="TPU-native JuiceFS-capability distributed file system",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    for mod in (
+        format_cmd, mount, bench, objbench, gc, fsck, sync, dump, warmup, info,
+    ):
+        mod.add_parser(sub)
+    args = parser.parse_args(argv)
+    try:
+        return args.func(args) or 0
+    except KeyboardInterrupt:
+        return 130
+    except BrokenPipeError:
+        return 0  # output piped into head/less that exited early
+    except Exception as e:
+        logger.error("%s: %s", args.command, e)
+        return 1
+
+
+def cli_entry() -> None:
+    sys.exit(main())

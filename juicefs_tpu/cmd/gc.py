@@ -1,0 +1,144 @@
+"""`gc`: garbage-collect leaked objects; TPU content dedup scan.
+
+Reference cmd/gc.go:76-330: scan all slices from meta, list `chunks/`
+objects from the store, diff -> leaked/pending, optionally delete.
+
+New TPU-first capability (BASELINE.md north star): `--dedup` streams every
+live block through the batched JTH-256 pipeline and reports duplicate
+content groups and reclaimable bytes — content addressing the reference
+does not have (its gc diffs block *names* only, cmd/gc.go:253-296).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+from ..chunk.cached_store import block_key, parse_block_key
+from ..utils import get_logger
+
+logger = get_logger("cmd.gc")
+
+
+def add_parser(sub):
+    p = sub.add_parser("gc", help="collect leaked objects / dedup scan")
+    p.add_argument("meta_url")
+    p.add_argument("--delete", action="store_true", help="delete leaked objects")
+    p.add_argument("--compact", action="store_true", help="compact fragmented chunks")
+    p.add_argument("--dedup", action="store_true", help="content-addressed dedup scan")
+    p.add_argument("--hash-backend", default=None,
+                   help="cpu|xla|pallas (default: volume format hash_backend)")
+    p.add_argument("--threads", type=int, default=10)
+    p.add_argument("--age", type=float, default=3600.0,
+                   help="only treat objects older than this (seconds) as leaked")
+    p.add_argument("--dedup-index", default="", help="write content index JSON here")
+    p.set_defaults(func=run)
+
+
+def run(args) -> int:
+    from . import build_store, open_meta
+
+    m, fmt = open_meta(args.meta_url)
+    store = build_store(fmt, args)
+    bs = fmt.block_size * 1024
+
+    if args.compact:
+        from ..vfs.compact import compact_all
+
+        n = compact_all(m, store)
+        print(f"compacted {n} chunks")
+
+    # live slice -> expected blocks
+    slices = m.list_slices()
+    live: dict[str, int] = {}
+    for ino, slcs in slices.items():
+        for s in slcs:
+            if s.id == 0 or s.size == 0:
+                continue
+            n_blocks = (s.size + bs - 1) // bs
+            for i in range(n_blocks):
+                bsize = min(bs, s.size - i * bs)
+                live[block_key(s.id, i, bsize)] = bsize
+
+    # stored objects under chunks/
+    import time as _time
+
+    cutoff = _time.time() - args.age
+    stored = {}
+    recent = set()
+    for obj in store.storage.list_all("chunks/"):
+        parsed = parse_block_key(obj.key)
+        if parsed is not None:
+            stored[obj.key] = obj.size
+            if obj.mtime > cutoff:
+                recent.add(obj.key)
+
+    # An object can be uploaded before its slice commits to meta (the write
+    # pipeline is async), so fresh objects are never "leaked" (reference gc
+    # skips recent blocks for the same reason).
+    leaked = [k for k in stored if k not in live and k not in recent]
+    missing = [k for k in live if k not in stored]
+    print(
+        f"scanned: {len(stored)} objects, {len(live)} live blocks, "
+        f"{len(leaked)} leaked, {len(missing)} missing"
+    )
+    if missing:
+        for k in missing[:10]:
+            logger.warning("missing block: %s", k)
+
+    if leaked and args.delete:
+        with ThreadPoolExecutor(max_workers=args.threads) as pool:
+            list(pool.map(store.storage.delete, leaked))
+        print(f"deleted {len(leaked)} leaked objects")
+
+    if args.dedup:
+        backend = args.hash_backend or (
+            "xla" if fmt.hash_backend == "tpu" else "cpu"
+        )
+        stats = dedup_scan(m, store, live, backend, args.dedup_index, bs)
+        print(json.dumps(stats))
+    return 0
+
+
+def dedup_scan(meta, store, live: dict[str, int], backend: str,
+               index_path: str, block_size: int) -> dict:
+    """Stream every live block through the hash pipeline; group duplicates."""
+    from ..tpu.dedup import dedup_digests
+    from ..tpu.jth256 import digest_hex
+    from ..tpu.pipeline import HashPipeline, PipelineConfig
+
+    pad_lanes = max(1, block_size // 65536)
+    pipe = HashPipeline(PipelineConfig(backend=backend, pad_lanes=pad_lanes))
+
+    def blocks():
+        for key, bsize in live.items():
+            try:
+                yield key, store._load_block(key, bsize, cache_after=False)
+            except Exception as e:
+                logger.warning("read %s: %s", key, e)
+
+    keys, digests = [], []
+    for key, digest in pipe.hash_stream(blocks()):
+        keys.append(key)
+        digests.append(digest)
+    dup_mask, first_idx = dedup_digests(digests)
+    dup_bytes = sum(live[keys[i]] for i, d in enumerate(dup_mask) if d)
+    groups: dict[str, list[str]] = {}
+    for i, d in enumerate(dup_mask):
+        if d:
+            groups.setdefault(keys[first_idx[i]], []).append(keys[i])
+    if index_path:
+        with open(index_path, "w") as f:
+            json.dump(
+                {keys[i]: digest_hex(digests[i]) for i in range(len(keys))},
+                f,
+                indent=1,
+            )
+    return {
+        "blocks": len(keys),
+        "bytes": sum(live.values()),
+        "duplicate_blocks": int(dup_mask.sum()),
+        "duplicate_bytes": int(dup_bytes),
+        "dedup_groups": len(groups),
+        "backend": backend,
+    }

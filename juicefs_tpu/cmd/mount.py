@@ -1,0 +1,112 @@
+"""`mount` / `umount`: serve a volume through the kernel (reference
+cmd/mount.go:541, cmd/mount_unix.go).
+
+Foreground by default; -d daemonizes with a supervisor that restarts the
+serving child on crash (reference launchMount restart loop
+cmd/mount_unix.go:691-757)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+from ..utils import get_logger
+
+logger = get_logger("cmd.mount")
+
+
+def add_parser(sub):
+    p = sub.add_parser("mount", help="mount a volume")
+    p.add_argument("meta_url")
+    p.add_argument("mountpoint")
+    p.add_argument("-d", "--background", action="store_true")
+    p.add_argument("--readonly", action="store_true")
+    p.add_argument("--allow-other", action="store_true")
+    p.add_argument("--cache-dir", default="", help="colon-separated dirs or 'memory'")
+    p.add_argument("--cache-size", default=0, type=int, help="cache size MiB")
+    p.add_argument("--writeback", action="store_true")
+    p.add_argument("--max-readahead", type=int, default=8, help="MiB")
+    p.set_defaults(func=run)
+
+    u = sub.add_parser("umount", help="unmount a volume")
+    u.add_argument("mountpoint")
+    u.add_argument("-f", "--force", action="store_true")
+    u.set_defaults(func=run_umount)
+
+
+def serve(args) -> int:
+    from ..fuse import Server
+    from ..vfs import VFS, VFSConfig
+    from . import build_store, open_meta
+
+    m, fmt = open_meta(args.meta_url)
+    m.new_session(heartbeat=12.0)
+    store = build_store(fmt, args)
+    vfs = VFS(
+        m,
+        store,
+        VFSConfig(readonly=args.readonly, max_readahead=args.max_readahead << 20),
+        fmt,
+    )
+    srv = Server(vfs, args.mountpoint, fsname=f"juicefs-tpu:{fmt.name}",
+                 allow_other=args.allow_other)
+    srv.mount()
+    logger.info("volume %s mounted at %s", fmt.name, args.mountpoint)
+
+    def _stop(signum, frame):
+        srv.unmount()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    try:
+        srv.serve()
+    finally:
+        vfs.close()
+        m.close_session()
+    return 0
+
+
+def run(args) -> int:
+    if not args.background:
+        return serve(args)
+    # Supervisor daemonization (reference 3-stage mount + restart loop).
+    pid = os.fork()
+    if pid > 0:
+        # parent: wait for the mount to appear, then return
+        for _ in range(100):
+            if _is_mountpoint(args.mountpoint):
+                print(f"mounted at {args.mountpoint} (supervisor pid {pid})")
+                return 0
+            time.sleep(0.1)
+        logger.error("mount did not come up")
+        return 1
+    # supervisor child
+    os.setsid()
+    restarts = 0
+    while True:
+        worker = os.fork()
+        if worker == 0:
+            sys.exit(serve(args))
+        _, status = os.waitpid(worker, 0)
+        code = os.waitstatus_to_exitcode(status)
+        if code == 0 or restarts > 10:
+            os._exit(0)
+        restarts += 1
+        logger.warning("mount worker died (%s), restart #%d", code, restarts)
+        time.sleep(min(restarts, 10))
+
+
+def _is_mountpoint(path: str) -> bool:
+    try:
+        return os.stat(path).st_dev != os.stat(os.path.dirname(os.path.abspath(path))).st_dev
+    except OSError:
+        return False
+
+
+def run_umount(args) -> int:
+    from ..fuse.mount import umount
+
+    umount(args.mountpoint, lazy=args.force)
+    return 0

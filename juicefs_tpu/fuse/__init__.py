@@ -1,0 +1,10 @@
+"""FUSE adapter (reference: pkg/fuse, SURVEY.md §2.1).
+
+Speaks the kernel FUSE ABI directly over /dev/fuse (no libfuse), mounting
+via the setuid fusermount fd-passing handshake, and serves the VFS.
+"""
+
+from .mount import mount, umount
+from .server import Server
+
+__all__ = ["Server", "mount", "umount"]

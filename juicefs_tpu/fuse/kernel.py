@@ -1,0 +1,150 @@
+"""FUSE kernel ABI: opcodes and wire structs (speaks /dev/fuse directly).
+
+Equivalent of the go-fuse layer the reference sits on (pkg/fuse/fuse.go:84
+delegates kernel requests 1:1 to VFS; go-fuse itself encodes the ABI in
+pure Go). Same approach here: no libfuse — the server opens /dev/fuse via
+the fusermount handshake and speaks the kernel protocol directly, so the
+adapter is dependency-free and testable against a real kernel mount.
+
+Struct layouts follow include/uapi/linux/fuse.h. We negotiate ABI 7.31+
+conservatively: fixed-size fuse_attr with blksize, 64-byte init_out,
+max_write raised via FUSE_MAX_PAGES.
+"""
+
+from __future__ import annotations
+
+import struct
+
+FUSE_KERNEL_VERSION = 7
+FUSE_KERNEL_MINOR = 36
+
+# opcodes (linux/fuse.h)
+LOOKUP = 1
+FORGET = 2
+GETATTR = 3
+SETATTR = 4
+READLINK = 5
+SYMLINK = 6
+MKNOD = 8
+MKDIR = 9
+UNLINK = 10
+RMDIR = 11
+RENAME = 12
+LINK = 13
+OPEN = 14
+READ = 15
+WRITE = 16
+STATFS = 17
+RELEASE = 18
+FSYNC = 20
+SETXATTR = 21
+GETXATTR = 22
+LISTXATTR = 23
+REMOVEXATTR = 24
+FLUSH = 25
+INIT = 26
+OPENDIR = 27
+READDIR = 28
+RELEASEDIR = 29
+FSYNCDIR = 30
+GETLK = 31
+SETLK = 32
+SETLKW = 33
+ACCESS = 34
+CREATE = 35
+INTERRUPT = 36
+BMAP = 37
+DESTROY = 38
+IOCTL = 39
+POLL = 40
+NOTIFY_REPLY = 41
+BATCH_FORGET = 42
+FALLOCATE = 43
+READDIRPLUS = 44
+RENAME2 = 45
+LSEEK = 46
+COPY_FILE_RANGE = 47
+SETUPMAPPING = 48
+REMOVEMAPPING = 49
+SYNCFS = 50
+TMPFILE = 51
+STATX = 52
+
+OPCODE_NAMES = {
+    v: k
+    for k, v in list(globals().items())
+    if isinstance(v, int) and k.isupper() and not k.startswith("FUSE")
+}
+
+# init flags (subset we care about)
+FUSE_ASYNC_READ = 1 << 0
+FUSE_BIG_WRITES = 1 << 5
+FUSE_DONT_MASK = 1 << 6
+FUSE_AUTO_INVAL_DATA = 1 << 12
+FUSE_ASYNC_DIO = 1 << 15
+FUSE_PARALLEL_DIROPS = 1 << 18
+FUSE_MAX_PAGES = 1 << 22
+FUSE_INIT_EXT = 1 << 30
+
+IN_HEADER = struct.Struct("<IIQQIIII")  # len opcode unique nodeid uid gid pid pad
+OUT_HEADER = struct.Struct("<IiQ")  # len error unique
+IN_HEADER_SIZE = IN_HEADER.size  # 40
+OUT_HEADER_SIZE = OUT_HEADER.size  # 16
+
+INIT_IN = struct.Struct("<IIII")  # major minor max_readahead flags (+ext)
+INIT_OUT = struct.Struct("<IIIIHHIIHHI28x")  # 64 bytes total
+ATTR = struct.Struct("<QQQQQQIIIIIIIIII")  # 88 bytes: ino size blocks a/m/ctime
+# a/m/c nsec mode nlink uid gid rdev blksize flags
+ENTRY_OUT = struct.Struct("<QQQQII")  # nodeid generation entry_valid attr_valid + nsecs
+ATTR_OUT = struct.Struct("<QII")  # attr_valid attr_valid_nsec dummy
+GETATTR_IN = struct.Struct("<IIQ")  # flags dummy fh
+SETATTR_IN = struct.Struct("<IIQQQQQQIIIIIIII")  # 88 bytes
+OPEN_IN = struct.Struct("<II")  # flags open_flags
+OPEN_OUT = struct.Struct("<QII")  # fh open_flags padding
+CREATE_IN = struct.Struct("<IIII")  # flags mode umask open_flags
+MKNOD_IN = struct.Struct("<IIII")  # mode rdev umask padding
+MKDIR_IN = struct.Struct("<II")  # mode umask
+RENAME_IN = struct.Struct("<Q")  # newdir
+RENAME2_IN = struct.Struct("<QII")  # newdir flags padding
+LINK_IN = struct.Struct("<Q")  # oldnodeid
+READ_IN = struct.Struct("<QQIIQII")  # fh offset size read_flags lock_owner flags pad
+WRITE_IN = struct.Struct("<QQIIQII")  # fh offset size write_flags lock_owner flags pad
+WRITE_OUT = struct.Struct("<II")  # size padding
+RELEASE_IN = struct.Struct("<QIIQ")  # fh flags release_flags lock_owner
+FLUSH_IN = struct.Struct("<QIIQ")  # fh unused padding lock_owner
+FSYNC_IN = struct.Struct("<QII")  # fh fsync_flags padding
+STATFS_OUT = struct.Struct("<QQQQQIIII24x")  # kstatfs, 80 bytes
+GETXATTR_IN = struct.Struct("<II")  # size padding
+GETXATTR_OUT = struct.Struct("<II")  # size padding
+SETXATTR_IN = struct.Struct("<II")  # size flags (non-ext form)
+ACCESS_IN = struct.Struct("<II")  # mask padding
+FORGET_IN = struct.Struct("<Q")  # nlookup
+BATCH_FORGET_IN = struct.Struct("<II")  # count dummy
+INTERRUPT_IN = struct.Struct("<Q")  # unique
+FALLOCATE_IN = struct.Struct("<QQQII")  # fh offset length mode padding
+COPY_FILE_RANGE_IN = struct.Struct("<QQQQQQQ")  # fh_in off_in nodeid_out fh_out off_out len flags
+LSEEK_IN = struct.Struct("<QQII")  # fh offset whence padding
+LSEEK_OUT = struct.Struct("<Q")
+LK_IN = struct.Struct("<QQQQIIII")  # fh owner start end type pid lk_flags pad
+LK_OUT = struct.Struct("<QQII")  # start end type pid
+DIRENT_HEADER = struct.Struct("<QQII")  # ino off namelen type
+
+# setattr valid bits (FATTR_*)
+FATTR_MODE = 1 << 0
+FATTR_UID = 1 << 1
+FATTR_GID = 1 << 2
+FATTR_SIZE = 1 << 3
+FATTR_ATIME = 1 << 4
+FATTR_MTIME = 1 << 5
+FATTR_FH = 1 << 6
+FATTR_ATIME_NOW = 1 << 7
+FATTR_MTIME_NOW = 1 << 8
+FATTR_LOCKOWNER = 1 << 9
+FATTR_CTIME = 1 << 10
+
+
+def pack_dirent(ino: int, off: int, name: bytes, dtype: int) -> bytes:
+    """One fuse_dirent, name 8-byte aligned zero-padded."""
+    ent = DIRENT_HEADER.pack(ino, off, len(name), dtype) + name
+    pad = (-len(ent)) % 8
+    return ent + b"\0" * pad

@@ -1,0 +1,77 @@
+"""Mount/unmount via the fusermount fd-passing handshake.
+
+fusermount(1) is setuid: it performs the privileged mount(2) and hands the
+opened /dev/fuse fd back over a unix socketpair named by _FUSE_COMMFD
+(the same mechanism go-fuse and libfuse use). Direct mount(2) is used
+when running as root and fusermount is absent.
+"""
+
+from __future__ import annotations
+
+import array
+import os
+import socket
+import subprocess
+
+from ..utils import get_logger
+
+logger = get_logger("fuse.mount")
+
+
+def fusermount(mountpoint: str, options: str) -> int:
+    """Mount via setuid fusermount; returns the /dev/fuse fd."""
+    s0, s1 = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        env = dict(os.environ, _FUSE_COMMFD=str(s1.fileno()))
+        proc = subprocess.run(
+            ["fusermount", "-o", options, "--", mountpoint],
+            env=env,
+            pass_fds=(s1.fileno(),),
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            raise OSError(
+                f"fusermount failed ({proc.returncode}): {proc.stderr.decode().strip()}"
+            )
+        _, anc, _, _ = s0.recvmsg(4, socket.CMSG_SPACE(4))
+        fds = array.array("i")
+        for level, typ, data in anc:
+            if level == socket.SOL_SOCKET and typ == socket.SCM_RIGHTS:
+                fds.frombytes(data[: len(data) - len(data) % 4])
+        if not fds:
+            raise OSError("fusermount did not pass back a /dev/fuse fd")
+        fd = fds[0]
+        os.set_inheritable(fd, False)
+        return fd
+    finally:
+        s0.close()
+        s1.close()
+
+
+def mount(
+    mountpoint: str,
+    fsname: str = "juicefs-tpu",
+    allow_other: bool = False,
+    readonly: bool = False,
+) -> int:
+    opts = [
+        f"fsname={fsname}",
+        "subtype=juicefs",
+        "nosuid",
+        "nodev",
+        "default_permissions",
+    ]
+    opts.append("ro" if readonly else "rw")
+    if allow_other:
+        opts.append("allow_other")
+    return fusermount(mountpoint, ",".join(opts))
+
+
+def umount(mountpoint: str, lazy: bool = True) -> None:
+    args = ["fusermount", "-u"]
+    if lazy:
+        args.append("-z")
+    args.append(mountpoint)
+    proc = subprocess.run(args, capture_output=True)
+    if proc.returncode != 0:
+        logger.warning("fusermount -u %s: %s", mountpoint, proc.stderr.decode().strip())

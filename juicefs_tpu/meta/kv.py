@@ -839,6 +839,30 @@ class KVMeta(BaseMeta):
             return 0, []
         return 0, Slice.decode_list(raw)
 
+    def do_compact_chunk(self, ino: int, indx: int, snapshot: bytes, new_slice: Slice) -> int:
+        """Replace the compacted prefix of a chunk's slice list with one
+        merged slice (reference base.go:2009 compactChunk txn). `snapshot`
+        is the encoded slice list the merged data was built from; slices
+        appended concurrently stay, anything else means a conflicting
+        compaction already won (EINVAL -> caller discards its work)."""
+
+        def fn(tx: KVTxn):
+            key = self._chunk_key(ino, indx)
+            raw = tx.get(key) or b""
+            if not raw.startswith(snapshot):
+                return errno.EINVAL
+            tail = raw[len(snapshot):]
+            tx.set(key, new_slice.encode() + tail)
+            for s in Slice.decode_list(snapshot):
+                if s.id:
+                    self._decref_slice(tx, s.id, s.size)
+            return 0
+
+        st = self._txn_notify(fn)
+        if st == 0:
+            self.of.invalidate_chunk(ino, indx)
+        return st
+
     def do_write_chunk(self, ino, indx, pos, slc: Slice, length_hint: int, incref: bool = False) -> int:
         def fn(tx: KVTxn):
             attr = self._get_attr(tx, ino)
@@ -1001,36 +1025,18 @@ class KVMeta(BaseMeta):
 
     def do_list_slices(self) -> dict[int, list[Slice]]:
         out: dict[int, list[Slice]] = {}
-        for k, v in self.client.scan(b"A", next_key(b"A")):
-            if len(k) >= 13 and k[9:10] == b"C":
-                ino = int.from_bytes(k[1:9], "big")
-                out.setdefault(ino, []).extend(
-                    s for s in Slice.decode_list(v) if s.id
-                )
+        for (ino, _indx), slcs in self.list_chunks():
+            out.setdefault(ino, []).extend(s for s in slcs if s.id)
         return out
 
-    def compact_chunk(self, ino: int, indx: int, new_id: int, new_size: int, n_old: int) -> int:
-        """Atomically replace the first n_old slice records with one merged
-        slice (reference base.go:2009 compactChunk). Fails with EINVAL if the
-        chunk changed concurrently (caller re-reads and retries)."""
-
-        def fn(tx: KVTxn):
-            key = self._chunk_key(ino, indx)
-            raw = tx.get(key)
-            if raw is None or len(raw) // Slice.ENCODED_LEN < n_old:
-                return errno.EINVAL
-            olds = Slice.decode_list(raw[: n_old * Slice.ENCODED_LEN])
-            rest = raw[n_old * Slice.ENCODED_LEN:]
-            view = build_slice(olds)
-            total = max((s.pos + s.len for s in view), default=0)
-            merged = Slice(pos=0, id=new_id, size=new_size, off=0, len=total)
-            tx.set(key, merged.encode() + rest)
-            for s in olds:
-                if s.id:
-                    self._decref_slice(tx, s.id, s.size)
-            return 0
-
-        return self._txn_notify(fn)
+    def list_chunks(self):
+        """Yield ((ino, indx), slices) for every chunk record — the scan
+        feeding compaction and gc (reference base.go scanAllChunks)."""
+        for k, v in self.client.scan(b"A", next_key(b"A")):
+            if len(k) == 14 and k[9:10] == b"C":
+                ino = int.from_bytes(k[1:9], "big")
+                indx = int.from_bytes(k[10:14], "big")
+                yield (ino, indx), Slice.decode_list(v)
 
     # ---- xattr -----------------------------------------------------------
     def do_getxattr(self, ino, name) -> tuple[int, bytes]:

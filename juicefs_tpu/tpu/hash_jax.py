@@ -30,13 +30,21 @@ from .jth256 import (
 )
 from . import jth256 as _spec
 
-_P1 = jnp.uint32(0x9E3779B1)
-_P2 = jnp.uint32(0x85EBCA77)
-_P3 = jnp.uint32(0xC2B2AE3D)
-_P4 = jnp.uint32(0x27D4EB2F)
-_P5 = jnp.uint32(0x165667B1)
-_FM1 = jnp.uint32(0x85EBCA6B)
-_FM2 = jnp.uint32(0xC2B2AE35)
+# Plain ints here: wrapping them in jnp.uint32 at module scope would
+# initialize a JAX backend at import time, breaking accelerator-free
+# environments (the CPU fallback path must import cleanly). Each use below
+# casts under trace via _u32().
+_P1 = 0x9E3779B1
+_P2 = 0x85EBCA77
+_P3 = 0xC2B2AE3D
+_P4 = 0x27D4EB2F
+_P5 = 0x165667B1
+_FM1 = 0x85EBCA6B
+_FM2 = 0xC2B2AE35
+
+
+def _u32(c: int):
+    return jnp.uint32(c)
 
 
 def _rotl(x, k: int):
@@ -45,9 +53,9 @@ def _rotl(x, k: int):
 
 def _fmix(x):
     x = x ^ (x >> jnp.uint32(16))
-    x = x * _FM1
+    x = x * _u32(_FM1)
     x = x ^ (x >> jnp.uint32(13))
-    x = x * _FM2
+    x = x * _u32(_FM2)
     return x ^ (x >> jnp.uint32(16))
 
 
@@ -55,8 +63,8 @@ def _row_chain_scan(words: jax.Array, s0: jax.Array) -> jax.Array:
     """128-row mixing chain via lax.scan. words (B,M,128,128), s0 (B,M,128)."""
 
     def step(s, w):
-        s = (s ^ w) * _P1
-        s = _rotl(s, 13) * _P2
+        s = (s ^ w) * _u32(_P1)
+        s = _rotl(s, 13) * _u32(_P2)
         s = s ^ (s >> jnp.uint32(15))
         return s, None
 
@@ -70,7 +78,7 @@ def _lane_states(words: jax.Array, lane_offset=0) -> jax.Array:
     b, m = words.shape[0], words.shape[1]
     j = jnp.arange(COLS, dtype=jnp.uint32)
     lanes = jnp.arange(m, dtype=jnp.uint32) + jnp.uint32(lane_offset)
-    s0 = _P5 ^ (j * _P1)[None, None, :] ^ (lanes * _P3)[None, :, None]
+    s0 = _u32(_P5) ^ (j * _u32(_P1))[None, None, :] ^ (lanes * _u32(_P3))[None, :, None]
     return jnp.broadcast_to(s0, (b, m, COLS))
 
 
@@ -81,11 +89,11 @@ def _lane_accs(s: jax.Array, lane_offset=0) -> jax.Array:
     k8 = jnp.arange(8, dtype=jnp.uint32)
     g = s.reshape(b, m, 16, 8)
     acc = jnp.broadcast_to(
-        _P4 ^ (lanes * _P2)[None, :, None] ^ (k8 * _P1)[None, None, :],
+        _u32(_P4) ^ (lanes * _u32(_P2))[None, :, None] ^ (k8 * _u32(_P1))[None, None, :],
         (b, m, 8),
     )
     for gi in range(16):
-        acc = _rotl((acc ^ g[:, :, gi, :]) * _P3, 11) + jnp.uint32(gi) * _P5
+        acc = _rotl((acc ^ g[:, :, gi, :]) * _u32(_P3), 11) + jnp.uint32(gi) * _u32(_P5)
     return acc
 
 
@@ -101,12 +109,12 @@ def _combine_accs(
 
     def lane_step(h, inp):
         d, li = inp
-        hn = _rotl((h ^ d) * _P2, 17) + li * _P1
+        hn = _rotl((h ^ d) * _u32(_P2), 17) + li * _u32(_P1)
         live = (counts > li)[:, None]
         return jnp.where(live, hn, h), None
 
     h, _ = lax.scan(lane_step, h0, (jnp.moveaxis(acc, 1, 0), lanes))
-    h = h ^ (lengths.astype(jnp.uint32)[:, None] + k8[None, :] * _P4)
+    h = h ^ (lengths.astype(jnp.uint32)[:, None] + k8[None, :] * _u32(_P4))
     return _fmix(h)
 
 

@@ -43,9 +43,22 @@ class HashPipeline:
         self.config = config or PipelineConfig()
         self._fn = None
         if self.config.backend != "cpu":
-            from .hash_jax import make_hash_fn
+            try:
+                import jax
 
-            self._fn = make_hash_fn(self.config.backend)
+                jax.devices()  # force backend init; may raise
+                from .hash_jax import make_hash_fn
+
+                self._fn = make_hash_fn(self.config.backend)
+            except Exception as e:  # no usable accelerator: digests must
+                # still flow, so degrade to the byte-identical CPU path.
+                from ..utils import get_logger
+
+                get_logger("tpu.pipeline").warning(
+                    "backend %r unavailable (%s); falling back to cpu",
+                    self.config.backend, e,
+                )
+                self.config.backend = "cpu"
 
     def _hash_packed(self, words, counts, lengths):
         if self._fn is None:

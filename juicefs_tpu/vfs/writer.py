@@ -259,8 +259,14 @@ class DataWriter:
             fw.refs -= 1
             if fw.refs > 0:
                 return 0
-            self._files.pop(ino, None)
-        return fw.flush()
+        # Flush while the writer is still registered: a concurrent open()
+        # must find (and reuse) it, not create a second writer whose newer
+        # slices could be shadowed by our late commits.
+        st = fw.flush()
+        with self._lock:
+            if fw.refs == 0 and self._files.get(ino) is fw:
+                self._files.pop(ino, None)
+        return st
 
     def find(self, ino: int) -> Optional[FileWriter]:
         with self._lock:

@@ -438,11 +438,16 @@ def test_compact_chunk(m):
         m.write_chunk(ino, 0, i * 1000, Slice(pos=i * 1000, id=sid, size=1000, off=0, len=1000))
     deleted = []
     m.on_msg(meta_interface.DELETE_SLICE, lambda sid, size: deleted.append(sid))
+    st, slices = m.read_chunk(ino, 0)
+    snapshot = b"".join(s.encode() for s in slices)
     new_id = m.new_slice()
-    assert m.compact_chunk(ino, 0, new_id, 4000, 4) == 0
+    merged = Slice(pos=0, id=new_id, size=4000, off=0, len=4000)
+    assert m.do_compact_chunk(ino, 0, snapshot, merged) == 0
     st, slices = m.read_chunk(ino, 0)
     assert len(slices) == 1 and slices[0].id == new_id and slices[0].len == 4000
     assert sorted(deleted) == sorted(sids)
+    # a stale snapshot must lose (concurrent compaction protection)
+    assert m.do_compact_chunk(ino, 0, snapshot, merged) != 0
     m.close(CTX, ino)
 
 
