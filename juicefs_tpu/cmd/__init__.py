@@ -80,6 +80,7 @@ def main(argv: list[str] | None = None) -> int:
         dump,
         format as format_cmd,
         fsck,
+        gateway,
         gc,
         info,
         mount,
@@ -94,7 +95,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     sub = parser.add_subparsers(dest="command", required=True)
     for mod in (
-        format_cmd, mount, bench, objbench, gc, fsck, sync, dump, warmup, info,
+        format_cmd, mount, bench, objbench, gc, fsck, sync, dump, warmup,
+        info, gateway,
     ):
         mod.add_parser(sub)
     args = parser.parse_args(argv)

@@ -1,0 +1,76 @@
+"""`gateway` / `webdav`: serve the volume over S3 / WebDAV
+(reference cmd/gateway.go, cmd/webdav.go)."""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from ..utils import get_logger
+
+logger = get_logger("cmd.gateway")
+
+
+def add_parser(sub):
+    g = sub.add_parser("gateway", help="serve the volume over the S3 API")
+    g.add_argument("meta_url")
+    g.add_argument("--address", default="127.0.0.1")
+    g.add_argument("--port", type=int, default=9000)
+    g.add_argument("--cache-dir", default="")
+    g.add_argument("--cache-size", type=int, default=0)
+    g.add_argument("--writeback", action="store_true")
+    g.set_defaults(func=run_gateway)
+
+    w = sub.add_parser("webdav", help="serve the volume over WebDAV")
+    w.add_argument("meta_url")
+    w.add_argument("--address", default="127.0.0.1")
+    w.add_argument("--port", type=int, default=9007)
+    w.add_argument("--cache-dir", default="")
+    w.add_argument("--cache-size", type=int, default=0)
+    w.add_argument("--writeback", action="store_true")
+    w.set_defaults(func=run_webdav)
+
+
+def _build_fs(args):
+    from ..fs import FileSystem
+    from ..vfs import VFS
+    from . import build_store, open_meta
+
+    m, fmt = open_meta(args.meta_url)
+    m.new_session(heartbeat=12.0)
+    vfs = VFS(m, build_store(fmt, args), fmt=fmt)
+    return FileSystem(vfs), vfs, m
+
+
+def _serve_forever(vfs, m, server, what: str, port: int):
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    print(f"{what} listening on port {port}")
+    stop.wait()
+    server.stop()
+    vfs.close()
+    m.close_session()
+    return 0
+
+
+def run_gateway(args) -> int:
+    from ..gateway import S3Gateway
+
+    fs, vfs, m = _build_fs(args)
+    gw = S3Gateway(fs, args.address, args.port)
+    port = gw.start()
+    return _serve_forever(vfs, m, gw, "S3 gateway", port)
+
+
+def run_webdav(args) -> int:
+    from ..gateway.webdav import WebDAVServer
+
+    fs, vfs, m = _build_fs(args)
+    srv = WebDAVServer(fs, args.address, args.port)
+    port = srv.start()
+    return _serve_forever(vfs, m, srv, "WebDAV", port)

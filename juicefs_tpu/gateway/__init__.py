@@ -1,0 +1,10 @@
+"""S3 gateway (reference: pkg/gateway, SURVEY.md §2.1).
+
+Serves the volume over the S3 REST API: buckets are top-level directories,
+objects are files (reference gateway.go:65 NewJFSGateway; multipart state
+under .sys/multipart like gateway.go:188-196).
+"""
+
+from .s3 import S3Gateway
+
+__all__ = ["S3Gateway"]

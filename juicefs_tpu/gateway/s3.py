@@ -1,0 +1,448 @@
+"""S3-compatible HTTP gateway over the FileSystem SDK.
+
+Mirrors the reference's MinIO-based gateway semantics (pkg/gateway):
+  - buckets = top-level directories of the volume (gateway.go jfsObjects)
+  - objects = files; "dir/" keys list by prefix via the namespace itself
+  - multipart uploads assemble under /.sys/multipart (gateway.go:188-196)
+  - ETag = hex JTH-256 prefix stored in an xattr (etag-in-xattr like the
+    reference's s3-etag xattr)
+
+Implements the subset real clients exercise: ListBuckets, Create/Delete
+bucket, HeadBucket, ListObjectsV2 (prefix + delimiter + continuation),
+Get/Put/Head/Delete/Copy object, and multipart Create/UploadPart/
+Complete/Abort. Auth is accepted but not verified (deploy behind a
+trusted boundary or a signing proxy).
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import os
+import posixpath
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+from ..meta.types import TYPE_DIRECTORY
+from ..tpu.jth256 import digest_hex, jth256
+from ..utils import get_logger
+from ..fs import FSError, FileSystem
+
+logger = get_logger("gateway.s3")
+
+SYS_MULTIPART = "/.sys/multipart"
+ETAG_XATTR = b"s3.etag"
+NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _etag(data: bytes) -> str:
+    return digest_hex(jth256(data))[:32]
+
+
+class S3Gateway:
+    def __init__(self, fs: FileSystem, address: str = "127.0.0.1", port: int = 9000):
+        self.fs = fs
+        self.address = address
+        self.port = port
+        self._server: ThreadingHTTPServer | None = None
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug(fmt, *args)
+
+            def _params(self):
+                u = urllib.parse.urlsplit(self.path)
+                q = urllib.parse.parse_qs(u.query, keep_blank_values=True)
+                parts = u.path.lstrip("/").split("/", 1)
+                bucket = urllib.parse.unquote(parts[0]) if parts[0] else ""
+                key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+                return bucket, key, q
+
+            def _xml(self, code: int, body: str):
+                data = ('<?xml version="1.0" encoding="UTF-8"?>' + body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/xml")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, code: int, s3code: str, msg: str = ""):
+                self._xml(code, f"<Error><Code>{s3code}</Code>"
+                                f"<Message>{escape(msg or s3code)}</Message></Error>")
+
+            def _empty(self, code: int = 200, headers: dict | None = None):
+                headers = headers or {}
+                self.send_response(code)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                if "Content-Length" not in headers:
+                    self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                remaining, chunks = n, []
+                while remaining > 0:
+                    chunk = self.rfile.read(min(remaining, 1 << 20))
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                    remaining -= len(chunk)
+                return b"".join(chunks)
+
+            # -- dispatch --------------------------------------------------
+            def do_GET(self):
+                bucket, key, q = self._params()
+                try:
+                    if not bucket:
+                        return gw._list_buckets(self)
+                    if not key:
+                        return gw._list_objects(self, bucket, q)
+                    return gw._get_object(self, bucket, key)
+                except FSError as e:
+                    self._map_fs_error(e)
+
+            def do_HEAD(self):
+                bucket, key, q = self._params()
+                try:
+                    if bucket and not key:
+                        gw.fs.stat("/" + bucket)
+                        return self._empty(200)
+                    return gw._head_object(self, bucket, key)
+                except FSError as e:
+                    self._empty(404 if e.errno == _errno.ENOENT else 500)
+
+            def do_PUT(self):
+                bucket, key, q = self._params()
+                try:
+                    if bucket and not key:
+                        return gw._create_bucket(self, bucket)
+                    if "partNumber" in q and "uploadId" in q:
+                        return gw._upload_part(
+                            self, bucket, key, q["uploadId"][0],
+                            int(q["partNumber"][0]),
+                        )
+                    return gw._put_object(self, bucket, key)
+                except FSError as e:
+                    self._map_fs_error(e)
+
+            def do_POST(self):
+                bucket, key, q = self._params()
+                try:
+                    if "uploads" in q:
+                        return gw._create_multipart(self, bucket, key)
+                    if "uploadId" in q:
+                        return gw._complete_multipart(self, bucket, key, q["uploadId"][0])
+                    self._error(400, "InvalidRequest")
+                except FSError as e:
+                    self._map_fs_error(e)
+
+            def do_DELETE(self):
+                bucket, key, q = self._params()
+                try:
+                    if "uploadId" in q:
+                        return gw._abort_multipart(self, bucket, key, q["uploadId"][0])
+                    if bucket and not key:
+                        return gw._delete_bucket(self, bucket)
+                    return gw._delete_object(self, bucket, key)
+                except FSError as e:
+                    self._map_fs_error(e)
+
+            def _map_fs_error(self, e: FSError):
+                if e.errno == _errno.ENOENT:
+                    self._error(404, "NoSuchKey", str(e))
+                elif e.errno == _errno.ENOTEMPTY:
+                    self._error(409, "BucketNotEmpty", str(e))
+                elif e.errno in (_errno.EACCES, _errno.EPERM):
+                    self._error(403, "AccessDenied", str(e))
+                else:
+                    self._error(500, "InternalError", str(e))
+
+        self._handler_cls = Handler
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> int:
+        self._server = ThreadingHTTPServer((self.address, self.port), self._handler_cls)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="s3-gateway").start()
+        logger.info("S3 gateway on %s:%d", self.address, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # -- bucket ops --------------------------------------------------------
+
+    def _list_buckets(self, h):
+        entries = self.fs.listdir("/", want_attr=True)
+        items = "".join(
+            f"<Bucket><Name>{escape(e.name.decode())}</Name>"
+            f"<CreationDate>1970-01-01T00:00:00.000Z</CreationDate></Bucket>"
+            for e in entries
+            if e.attr and e.attr.typ == TYPE_DIRECTORY and not e.name.startswith(b".")
+        )
+        h._xml(200, f'<ListAllMyBucketsResult xmlns="{NS}">'
+                    f"<Buckets>{items}</Buckets></ListAllMyBucketsResult>")
+
+    def _create_bucket(self, h, bucket: str):
+        try:
+            self.fs.mkdir("/" + bucket, 0o777)
+        except FSError as e:
+            if e.errno != _errno.EEXIST:
+                raise
+        h._empty(200, {"Location": "/" + bucket})
+
+    def _delete_bucket(self, h, bucket: str):
+        self.fs.rmdir("/" + bucket)
+        h._empty(204)
+
+    # -- object ops --------------------------------------------------------
+
+    def _obj_path(self, bucket: str, key: str) -> str:
+        p = posixpath.normpath(f"/{bucket}/{key}")
+        if not p.startswith(f"/{bucket}/"):
+            raise FSError(_errno.EPERM, key)  # path escape attempt
+        return p
+
+    def _put_object(self, h, bucket: str, key: str):
+        self.fs.stat("/" + bucket)
+        data = h._body()
+        path = self._obj_path(bucket, key)
+        if key.endswith("/"):
+            if data:
+                raise FSError(_errno.EINVAL, key)
+            self.fs.makedirs(path)
+            return h._empty(200, {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
+        copy_src = h.headers.get("x-amz-copy-source")
+        if copy_src:
+            src = urllib.parse.unquote(copy_src.lstrip("/"))
+            sbucket, _, skey = src.partition("/")
+            # Same escape guard as destination keys (no ../ traversal).
+            data = self.fs.read_file(self._obj_path(sbucket, skey))
+        parent = posixpath.dirname(path)
+        if parent != "/":
+            self.fs.makedirs(parent)
+        et = _etag(data)
+        with self.fs.create(path) as f:
+            if data:
+                f.write(data)
+        try:
+            self.fs.setxattr(path, ETAG_XATTR, et.encode())
+        except FSError:
+            pass
+        if copy_src:
+            return h._xml(200, f'<CopyObjectResult xmlns="{NS}">'
+                               f"<ETag>&quot;{et}&quot;</ETag></CopyObjectResult>")
+        h._empty(200, {"ETag": f'"{et}"'})
+
+    def _get_object(self, h, bucket: str, key: str):
+        path = self._obj_path(bucket, key)
+        attr = self.fs.stat(path)
+        if attr.typ == TYPE_DIRECTORY:
+            raise FSError(_errno.ENOENT, key)
+        rng = h.headers.get("Range")
+        start, end = 0, attr.length - 1
+        code = 200
+        if rng and rng.startswith("bytes="):
+            try:
+                spec = rng[6:].split("-")
+                if spec[0]:
+                    start = int(spec[0])
+                    if spec[1]:
+                        end = min(int(spec[1]), attr.length - 1)
+                else:  # suffix range
+                    start = max(0, attr.length - int(spec[1]))
+                code = 206
+            except (ValueError, IndexError):
+                start, end, code = 0, attr.length - 1, 200  # ignore bad Range
+        with self.fs.open(path) as f:
+            data = f.pread(start, end - start + 1) if attr.length else b""
+        h.send_response(code)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Content-Length", str(len(data)))
+        h.send_header("Last-Modified", _http_date(attr.mtime))
+        h.send_header("ETag", f'"{self._etag_of(path, attr)}"')
+        if code == 206:
+            h.send_header("Content-Range", f"bytes {start}-{end}/{attr.length}")
+        h.end_headers()
+        h.wfile.write(data)
+
+    def _head_object(self, h, bucket: str, key: str):
+        path = self._obj_path(bucket, key)
+        attr = self.fs.stat(path)
+        if attr.typ == TYPE_DIRECTORY and not key.endswith("/"):
+            raise FSError(_errno.ENOENT, key)
+        h._empty(200, {
+            "Content-Length": str(attr.length),
+            "Content-Type": "application/octet-stream",
+            "Last-Modified": _http_date(attr.mtime),
+            "ETag": f'"{self._etag_of(path, attr)}"',
+        })
+
+    def _delete_object(self, h, bucket: str, key: str):
+        path = self._obj_path(bucket, key)
+        try:
+            attr = self.fs.stat(path)
+            if attr.typ == TYPE_DIRECTORY:
+                self.fs.rmdir(path)
+            else:
+                self.fs.unlink(path)
+        except FSError as e:
+            if e.errno != _errno.ENOENT:  # S3 delete is idempotent
+                raise
+        h._empty(204)
+
+    def _etag_of(self, path: str, attr) -> str:
+        try:
+            return self.fs.getxattr(path, ETAG_XATTR).decode()
+        except FSError:
+            return f"{attr.length:x}-{attr.mtime:x}"
+
+    # -- listing -----------------------------------------------------------
+
+    def _list_objects(self, h, bucket: str, q):
+        self.fs.stat("/" + bucket)
+        prefix = q.get("prefix", [""])[0]
+        delimiter = q.get("delimiter", [""])[0]
+        max_keys = int(q.get("max-keys", ["1000"])[0])
+        token = q.get("continuation-token", q.get("marker", [""]))[0]
+
+        keys: list[tuple[str, object]] = []
+        self._walk(bucket, "", keys, prefix)
+        keys.sort(key=lambda kv: kv[0])
+
+        contents, prefixes = [], set()
+        truncated, next_token = False, ""
+        for key, attr in keys:
+            if token and key <= token:
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                cut = rest.find(delimiter)
+                if cut >= 0:
+                    prefixes.add(prefix + rest[: cut + 1])
+                    continue
+            if len(contents) >= max_keys:
+                truncated = True
+                next_token = contents[-1][0] if contents else key
+                break
+            contents.append((key, attr))
+
+        body = "".join(
+            f"<Contents><Key>{escape(k)}</Key>"
+            f"<LastModified>{_iso_date(a.mtime)}</LastModified>"
+            f"<Size>{a.length}</Size>"
+            f"<StorageClass>STANDARD</StorageClass></Contents>"
+            for k, a in contents
+        ) + "".join(
+            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>"
+            for p in sorted(prefixes)
+        )
+        h._xml(200, f'<ListBucketResult xmlns="{NS}">'
+                    f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+                    f"<KeyCount>{len(contents)}</KeyCount><MaxKeys>{max_keys}</MaxKeys>"
+                    f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+                    + (f"<NextContinuationToken>{escape(next_token)}</NextContinuationToken>"
+                       if truncated else "")
+                    + body + "</ListBucketResult>")
+
+    def _walk(self, bucket: str, rel: str, out: list, prefix: str):
+        try:
+            entries = self.fs.listdir(f"/{bucket}/{rel}" if rel else f"/{bucket}",
+                                      want_attr=True)
+        except FSError:
+            return
+        for e in entries:
+            name = e.name.decode()
+            key = f"{rel}{name}"
+            if e.attr and e.attr.typ == TYPE_DIRECTORY:
+                dkey = key + "/"
+                # prune subtrees that cannot match the prefix
+                if prefix and not dkey.startswith(prefix[: len(dkey)]):
+                    continue
+                if dkey.startswith(prefix) or prefix.startswith(dkey):
+                    if dkey.startswith(prefix):
+                        out.append((dkey, e.attr))
+                    self._walk(bucket, dkey, out, prefix)
+            elif key.startswith(prefix):
+                out.append((key, e.attr))
+
+    # -- multipart ---------------------------------------------------------
+
+    def _mp_dir(self, upload_id: str) -> str:
+        return f"{SYS_MULTIPART}/{upload_id}"
+
+    def _create_multipart(self, h, bucket: str, key: str):
+        self.fs.stat("/" + bucket)
+        upload_id = uuid.uuid4().hex
+        self.fs.makedirs(self._mp_dir(upload_id))
+        self.fs.write_file(f"{self._mp_dir(upload_id)}/.key",
+                           f"{bucket}/{key}".encode())
+        h._xml(200, f'<InitiateMultipartUploadResult xmlns="{NS}">'
+                    f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+                    f"<UploadId>{upload_id}</UploadId>"
+                    f"</InitiateMultipartUploadResult>")
+
+    def _upload_part(self, h, bucket: str, key: str, upload_id: str, num: int):
+        data = h._body()
+        part = f"{self._mp_dir(upload_id)}/{num:05d}"
+        self.fs.write_file(part, data)
+        h._empty(200, {"ETag": f'"{_etag(data)}"'})
+
+    def _complete_multipart(self, h, bucket: str, key: str, upload_id: str):
+        h._body()  # part manifest; we assemble all uploaded parts in order
+        mp = self._mp_dir(upload_id)
+        names = sorted(
+            e.name.decode() for e in self.fs.listdir(mp) if e.name != b".key"
+        )
+        path = self._obj_path(bucket, key)
+        parent = posixpath.dirname(path)
+        if parent != "/":
+            self.fs.makedirs(parent)
+        hasher_parts = []
+        with self.fs.create(path) as out:
+            for n in names:
+                data = self.fs.read_file(f"{mp}/{n}")
+                hasher_parts.append(_etag(data))
+                out.write(data)
+        self.fs.remove_all(mp)
+        et = _etag("".join(hasher_parts).encode()) + f"-{len(names)}"
+        try:
+            self.fs.setxattr(path, ETAG_XATTR, et.encode())
+        except FSError:
+            pass
+        h._xml(200, f'<CompleteMultipartUploadResult xmlns="{NS}">'
+                    f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+                    f"<ETag>&quot;{et}&quot;</ETag>"
+                    f"</CompleteMultipartUploadResult>")
+
+    def _abort_multipart(self, h, bucket: str, key: str, upload_id: str):
+        try:
+            self.fs.remove_all(self._mp_dir(upload_id))
+        except FSError:
+            pass
+        h._empty(204)
+
+
+def _http_date(ts: int) -> str:
+    import email.utils
+
+    return email.utils.formatdate(ts, usegmt=True)
+
+
+def _iso_date(ts: int) -> str:
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.000Z")

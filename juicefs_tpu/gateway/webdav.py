@@ -1,0 +1,213 @@
+"""WebDAV server over the FileSystem SDK (reference pkg/fs/http.go:84
+webdavFS over golang.org/x/net/webdav).
+
+Class-1 DAV: OPTIONS, PROPFIND (depth 0/1), GET/HEAD/PUT/DELETE, MKCOL,
+MOVE, COPY — the operations litmus and common DAV clients (davfs2, cadaver,
+macOS Finder) use for file management.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import posixpath
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+from ..meta.types import TYPE_DIRECTORY
+from ..utils import get_logger
+from ..fs import FSError, FileSystem
+
+logger = get_logger("gateway.webdav")
+
+
+class WebDAVServer:
+    def __init__(self, fs: FileSystem, address: str = "127.0.0.1", port: int = 9007):
+        self.fs = fs
+        self.address = address
+        self.port = port
+        self._server: ThreadingHTTPServer | None = None
+        dav = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug(fmt, *args)
+
+            def _path(self) -> str:
+                return urllib.parse.unquote(
+                    urllib.parse.urlsplit(self.path).path
+                ) or "/"
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                return self.rfile.read(n) if n else b""
+
+            def _empty(self, code: int, headers: dict | None = None):
+                headers = headers or {}
+                self.send_response(code)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                if "Content-Length" not in headers:
+                    self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def _err(self, e: FSError):
+                code = {
+                    _errno.ENOENT: 404,
+                    _errno.EEXIST: 405,
+                    _errno.ENOTEMPTY: 409,
+                    _errno.EACCES: 403,
+                    _errno.EPERM: 403,
+                    _errno.EISDIR: 405,
+                    _errno.ENOTDIR: 409,
+                }.get(e.errno, 500)
+                self._empty(code)
+
+            def do_OPTIONS(self):
+                self._empty(200, {"DAV": "1,2", "Allow":
+                                  "OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, "
+                                  "MKCOL, MOVE, COPY"})
+
+            def do_PROPFIND(self):
+                self._body()
+                path = self._path()
+                depth = self.headers.get("Depth", "1")
+                try:
+                    items = dav._propfind(path, depth)
+                except FSError as e:
+                    return self._err(e)
+                body = ('<?xml version="1.0" encoding="utf-8"?>'
+                        '<D:multistatus xmlns:D="DAV:">' + "".join(items) +
+                        "</D:multistatus>").encode()
+                self.send_response(207)
+                self.send_header("Content-Type", 'application/xml; charset="utf-8"')
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    attr = dav.fs.stat(self._path())
+                    if attr.typ == TYPE_DIRECTORY:
+                        return self._empty(405)
+                    data = dav.fs.read_file(self._path())
+                except FSError as e:
+                    return self._err(e)
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_HEAD(self):
+                try:
+                    attr = dav.fs.stat(self._path())
+                except FSError as e:
+                    return self._err(e)
+                self._empty(200, {"Content-Length": str(attr.length)})
+
+            def do_PUT(self):
+                data = self._body()
+                path = self._path()
+                try:
+                    parent = posixpath.dirname(path.rstrip("/"))
+                    if parent and parent != "/" and not dav.fs.exists(parent):
+                        return self._empty(409)  # RFC: no implicit collections
+                    dav.fs.write_file(path, data)
+                except FSError as e:
+                    return self._err(e)
+                self._empty(201)
+
+            def do_DELETE(self):
+                try:
+                    dav.fs.remove_all(self._path())
+                except FSError as e:
+                    return self._err(e)
+                self._empty(204)
+
+            def do_MKCOL(self):
+                if self._body():
+                    return self._empty(415)
+                try:
+                    dav.fs.mkdir(self._path().rstrip("/"))
+                except FSError as e:
+                    if e.errno == _errno.ENOENT:
+                        return self._empty(409)
+                    return self._err(e)
+                self._empty(201)
+
+            def _dest(self) -> str | None:
+                dst = self.headers.get("Destination")
+                if not dst:
+                    return None
+                return urllib.parse.unquote(urllib.parse.urlsplit(dst).path)
+
+            def do_MOVE(self):
+                dst = self._dest()
+                if not dst:
+                    return self._empty(400)
+                try:
+                    overwrote = dav.fs.exists(dst)
+                    if overwrote:
+                        if self.headers.get("Overwrite", "T") == "F":
+                            return self._empty(412)
+                        dav.fs.remove_all(dst)
+                    dav.fs.rename(self._path().rstrip("/"), dst.rstrip("/"))
+                except FSError as e:
+                    return self._err(e)
+                self._empty(204 if overwrote else 201)
+
+            def do_COPY(self):
+                dst = self._dest()
+                if not dst:
+                    return self._empty(400)
+                try:
+                    attr = dav.fs.stat(self._path())
+                    if attr.typ == TYPE_DIRECTORY:
+                        return self._empty(403)  # file copies only
+                    overwrote = dav.fs.exists(dst)
+                    if overwrote and self.headers.get("Overwrite", "T") == "F":
+                        return self._empty(412)
+                    dav.fs.write_file(dst, dav.fs.read_file(self._path()))
+                except FSError as e:
+                    return self._err(e)
+                self._empty(204 if overwrote else 201)
+
+        self._handler_cls = Handler
+
+    def _propfind(self, path: str, depth: str) -> list[str]:
+        attr = self.fs.stat(path)
+        items = [self._propstat(path, attr)]
+        if depth != "0" and attr.typ == TYPE_DIRECTORY:
+            for e in self.fs.listdir(path, want_attr=True):
+                child = posixpath.join(path, e.name.decode())
+                if e.attr is not None:
+                    items.append(self._propstat(child, e.attr))
+        return items
+
+    @staticmethod
+    def _propstat(path: str, attr) -> str:
+        is_dir = attr.typ == TYPE_DIRECTORY
+        href = urllib.parse.quote(path + ("/" if is_dir and path != "/" else ""))
+        rtype = "<D:collection/>" if is_dir else ""
+        length = "" if is_dir else f"<D:getcontentlength>{attr.length}</D:getcontentlength>"
+        return (f"<D:response><D:href>{escape(href)}</D:href><D:propstat><D:prop>"
+                f"<D:resourcetype>{rtype}</D:resourcetype>{length}"
+                f"</D:prop><D:status>HTTP/1.1 200 OK</D:status></D:propstat>"
+                f"</D:response>")
+
+    def start(self) -> int:
+        self._server = ThreadingHTTPServer((self.address, self.port), self._handler_cls)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="webdav").start()
+        logger.info("WebDAV on %s:%d", self.address, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
