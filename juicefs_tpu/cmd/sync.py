@@ -84,9 +84,9 @@ def _diff(src_iter, dst_iter, args):
 
 
 def _content_equal(src, dst, key: str) -> bool:
-    from ..tpu.jth256 import jth256
+    from .. import native
 
-    return jth256(bytes(src.get(key))) == jth256(bytes(dst.get(key)))
+    return native.jth256(bytes(src.get(key))) == native.jth256(bytes(dst.get(key)))
 
 
 def run(args) -> int:

@@ -1,10 +1,69 @@
-"""S3 gateway (reference: pkg/gateway, SURVEY.md §2.1).
+"""HTTP presentation adapters (reference: pkg/gateway + pkg/fs/http.go).
 
-Serves the volume over the S3 REST API: buckets are top-level directories,
-objects are files (reference gateway.go:65 NewJFSGateway; multipart state
-under .sys/multipart like gateway.go:188-196).
+Serves the volume over the S3 REST API (buckets = top-level directories;
+reference gateway.go:65 NewJFSGateway) and WebDAV. Shared here: the
+request-handler base (body/empty-response helpers) and the threaded
+server lifecycle both adapters use.
 """
 
-from .s3 import S3Gateway
+from __future__ import annotations
 
-__all__ = ["S3Gateway"]
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class BaseHandler(BaseHTTPRequestHandler):
+    """Common helpers for the S3 and WebDAV handlers."""
+
+    protocol_version = "HTTP/1.1"
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        remaining, chunks = n, []
+        while remaining > 0:
+            chunk = self.rfile.read(min(remaining, 1 << 20))
+            if not chunk:
+                break
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)
+
+    def _empty(self, code: int = 200, headers: dict | None = None):
+        headers = headers or {}
+        self.send_response(code)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        if "Content-Length" not in headers:
+            self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class HTTPAdapter:
+    """start()/stop() lifecycle shared by the S3 gateway and WebDAV."""
+
+    _name = "http"
+
+    def __init__(self, address: str, port: int):
+        self.address = address
+        self.port = port
+        self._server: ThreadingHTTPServer | None = None
+        self._handler_cls: type | None = None
+
+    def start(self) -> int:
+        self._server = ThreadingHTTPServer((self.address, self.port), self._handler_cls)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True, name=self._name
+        ).start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+from .s3 import S3Gateway  # noqa: E402
+
+__all__ = ["S3Gateway", "BaseHandler", "HTTPAdapter"]

@@ -17,18 +17,17 @@ trusted boundary or a signing proxy).
 from __future__ import annotations
 
 import errno as _errno
-import os
 import posixpath
-import threading
 import urllib.parse
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from xml.sax.saxutils import escape
 
 from ..meta.types import TYPE_DIRECTORY
-from ..tpu.jth256 import digest_hex, jth256
+from .. import native
+from ..tpu.jth256 import digest_hex
 from ..utils import get_logger
 from ..fs import FSError, FileSystem
+from . import BaseHandler, HTTPAdapter
 
 logger = get_logger("gateway.s3")
 
@@ -38,20 +37,18 @@ NS = "http://s3.amazonaws.com/doc/2006-03-01/"
 
 
 def _etag(data: bytes) -> str:
-    return digest_hex(jth256(data))[:32]
+    return digest_hex(native.jth256(data))[:32]
 
 
-class S3Gateway:
+class S3Gateway(HTTPAdapter):
+    _name = "s3-gateway"
+
     def __init__(self, fs: FileSystem, address: str = "127.0.0.1", port: int = 9000):
+        super().__init__(address, port)
         self.fs = fs
-        self.address = address
-        self.port = port
-        self._server: ThreadingHTTPServer | None = None
         gw = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
+        class Handler(BaseHandler):
             def log_message(self, fmt, *args):
                 logger.debug(fmt, *args)
 
@@ -75,26 +72,6 @@ class S3Gateway:
                 self._xml(code, f"<Error><Code>{s3code}</Code>"
                                 f"<Message>{escape(msg or s3code)}</Message></Error>")
 
-            def _empty(self, code: int = 200, headers: dict | None = None):
-                headers = headers or {}
-                self.send_response(code)
-                for k, v in headers.items():
-                    self.send_header(k, v)
-                if "Content-Length" not in headers:
-                    self.send_header("Content-Length", "0")
-                self.end_headers()
-
-            def _body(self) -> bytes:
-                n = int(self.headers.get("Content-Length", 0) or 0)
-                remaining, chunks = n, []
-                while remaining > 0:
-                    chunk = self.rfile.read(min(remaining, 1 << 20))
-                    if not chunk:
-                        break
-                    chunks.append(chunk)
-                    remaining -= len(chunk)
-                return b"".join(chunks)
-
             # -- dispatch --------------------------------------------------
             def do_GET(self):
                 bucket, key, q = self._params()
@@ -104,6 +81,8 @@ class S3Gateway:
                     if not key:
                         return gw._list_objects(self, bucket, q)
                     return gw._get_object(self, bucket, key)
+                except ValueError:
+                    self._error(400, "InvalidArgument")
                 except FSError as e:
                     self._map_fs_error(e)
 
@@ -128,6 +107,8 @@ class S3Gateway:
                             int(q["partNumber"][0]),
                         )
                     return gw._put_object(self, bucket, key)
+                except ValueError:
+                    self._error(400, "InvalidArgument")
                 except FSError as e:
                     self._map_fs_error(e)
 
@@ -139,6 +120,8 @@ class S3Gateway:
                     if "uploadId" in q:
                         return gw._complete_multipart(self, bucket, key, q["uploadId"][0])
                     self._error(400, "InvalidRequest")
+                except ValueError:
+                    self._error(400, "InvalidArgument")
                 except FSError as e:
                     self._map_fs_error(e)
 
@@ -150,6 +133,8 @@ class S3Gateway:
                     if bucket and not key:
                         return gw._delete_bucket(self, bucket)
                     return gw._delete_object(self, bucket, key)
+                except ValueError:
+                    self._error(400, "InvalidArgument")
                 except FSError as e:
                     self._map_fs_error(e)
 
@@ -164,22 +149,6 @@ class S3Gateway:
                     self._error(500, "InternalError", str(e))
 
         self._handler_cls = Handler
-
-    # -- lifecycle ---------------------------------------------------------
-
-    def start(self) -> int:
-        self._server = ThreadingHTTPServer((self.address, self.port), self._handler_cls)
-        self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True,
-                         name="s3-gateway").start()
-        logger.info("S3 gateway on %s:%d", self.address, self.port)
-        return self.port
-
-    def stop(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
 
     # -- bucket ops --------------------------------------------------------
 
@@ -265,6 +234,12 @@ class S3Gateway:
                 code = 206
             except (ValueError, IndexError):
                 start, end, code = 0, attr.length - 1, 200  # ignore bad Range
+            if code == 206 and start >= attr.length:
+                h.send_response(416)
+                h.send_header("Content-Range", f"bytes */{attr.length}")
+                h.send_header("Content-Length", "0")
+                h.end_headers()
+                return
         with self.fs.open(path) as f:
             data = f.pread(start, end - start + 1) if attr.length else b""
         h.send_response(code)
@@ -323,6 +298,8 @@ class S3Gateway:
 
         contents, prefixes = [], set()
         truncated, next_token = False, ""
+        if max_keys <= 0:
+            keys = []
         for key, attr in keys:
             if token and key <= token:
                 continue
@@ -333,8 +310,9 @@ class S3Gateway:
                     prefixes.add(prefix + rest[: cut + 1])
                     continue
             if len(contents) >= max_keys:
-                truncated = True
-                next_token = contents[-1][0] if contents else key
+                # max_keys >= 1 here, so contents is non-empty: the token is
+                # the last key actually returned.
+                truncated, next_token = True, contents[-1][0]
                 break
             contents.append((key, attr))
 

@@ -10,29 +10,26 @@ from __future__ import annotations
 
 import errno as _errno
 import posixpath
-import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from xml.sax.saxutils import escape
 
 from ..meta.types import TYPE_DIRECTORY
 from ..utils import get_logger
 from ..fs import FSError, FileSystem
+from . import BaseHandler, HTTPAdapter
 
 logger = get_logger("gateway.webdav")
 
 
-class WebDAVServer:
+class WebDAVServer(HTTPAdapter):
+    _name = "webdav"
+
     def __init__(self, fs: FileSystem, address: str = "127.0.0.1", port: int = 9007):
+        super().__init__(address, port)
         self.fs = fs
-        self.address = address
-        self.port = port
-        self._server: ThreadingHTTPServer | None = None
         dav = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
+        class Handler(BaseHandler):
             def log_message(self, fmt, *args):
                 logger.debug(fmt, *args)
 
@@ -40,19 +37,6 @@ class WebDAVServer:
                 return urllib.parse.unquote(
                     urllib.parse.urlsplit(self.path).path
                 ) or "/"
-
-            def _body(self) -> bytes:
-                n = int(self.headers.get("Content-Length", 0) or 0)
-                return self.rfile.read(n) if n else b""
-
-            def _empty(self, code: int, headers: dict | None = None):
-                headers = headers or {}
-                self.send_response(code)
-                for k, v in headers.items():
-                    self.send_header(k, v)
-                if "Content-Length" not in headers:
-                    self.send_header("Content-Length", "0")
-                self.end_headers()
 
             def _err(self, e: FSError):
                 code = {
@@ -121,8 +105,11 @@ class WebDAVServer:
                 self._empty(201)
 
             def do_DELETE(self):
+                path = self._path()
                 try:
-                    dav.fs.remove_all(self._path())
+                    if not dav.fs.exists(path):
+                        return self._empty(404)  # RFC 4918: missing -> 404
+                    dav.fs.remove_all(path)
                 except FSError as e:
                     return self._err(e)
                 self._empty(204)
@@ -198,16 +185,3 @@ class WebDAVServer:
                 f"</D:prop><D:status>HTTP/1.1 200 OK</D:status></D:propstat>"
                 f"</D:response>")
 
-    def start(self) -> int:
-        self._server = ThreadingHTTPServer((self.address, self.port), self._handler_cls)
-        self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True,
-                         name="webdav").start()
-        logger.info("WebDAV on %s:%d", self.address, self.port)
-        return self.port
-
-    def stop(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None

@@ -32,13 +32,22 @@ def _make_table() -> list[int]:
 _TABLE = _make_table()
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
-    """CRC32C (Castagnoli) — byte-identical to the reference's hash
-    (pkg/object/checksum.go uses crc32.Castagnoli)."""
+def crc32c_py(data: bytes, crc: int = 0) -> int:
+    """Pure-Python CRC32C (Castagnoli) — the spec/fallback implementation,
+    byte-identical to the reference's hash (checksum.go crc32.Castagnoli)."""
     c = crc ^ 0xFFFFFFFF
     for b in data:
         c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
     return c ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C via the native library (SSE4.2) with Python fallback."""
+    from .. import native
+
+    if native.available():
+        return native.crc32c(data, crc)
+    return crc32c_py(data, crc)
 
 
 class _Checksummed(ObjectStorage):

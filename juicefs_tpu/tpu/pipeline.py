@@ -18,13 +18,7 @@ from typing import Iterable, Iterator, Optional
 
 import numpy as np
 
-from .jth256 import (
-    BLOCK_BYTES,
-    LANE_BYTES,
-    digests_to_bytes,
-    hash_packed_np,
-    pack_blocks,
-)
+from .jth256 import BLOCK_BYTES, LANE_BYTES, digests_to_bytes, pack_blocks
 
 
 @dataclass
@@ -60,11 +54,6 @@ class HashPipeline:
                 )
                 self.config.backend = "cpu"
 
-    def _hash_packed(self, words, counts, lengths):
-        if self._fn is None:
-            return hash_packed_np(words, counts, lengths)
-        return self._fn(words, counts, lengths)
-
     def hash_stream(
         self, items: Iterable[tuple[str, bytes]]
     ) -> Iterator[tuple[str, bytes]]:
@@ -77,13 +66,20 @@ class HashPipeline:
             nonlocal keys, blocks
             if not blocks:
                 return
-            words, counts, lengths = pack_blocks(blocks, pad_lanes=cfg.pad_lanes)
-            pending.append((keys, self._hash_packed(words, counts, lengths)))
+            if self._fn is None:
+                # CPU path: hash raw bytes directly (native C++ batch with
+                # numpy fallback) — no packing cost, already synchronous.
+                from .. import native
+
+                pending.append((keys, native.jth256_batch(blocks)))
+            else:
+                words, counts, lengths = pack_blocks(blocks, pad_lanes=cfg.pad_lanes)
+                pending.append((keys, self._fn(words, counts, lengths)))
             keys, blocks = [], []
 
         def drain(batch) -> Iterator[tuple[str, bytes]]:
             bkeys, out = batch
-            digests = digests_to_bytes(np.asarray(out))
+            digests = out if isinstance(out, list) else digests_to_bytes(np.asarray(out))
             return zip(bkeys, digests[: len(bkeys)])
 
         for key, data in items:

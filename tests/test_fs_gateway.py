@@ -276,9 +276,16 @@ def test_fs_close_raises_on_flush_failure(fs, monkeypatch):
 def test_s3_edge_cases(s3):
     _req(s3, "PUT", "/b")
     _req(s3, "PUT", "/b/k1", body=b"x", headers={"Content-Length": "1"})
-    # max-keys=0 must not crash
+    # max-keys=0: empty result, not truncated (matches real S3), no crash
     st, _, body = _req(s3, "GET", "/b?list-type=2&max-keys=0")
-    assert st == 200 and b"true" in body
+    assert st == 200 and b"<KeyCount>0</KeyCount>" in body
+    assert b"<IsTruncated>false</IsTruncated>" in body
+    # non-numeric max-keys -> 400, connection stays alive
+    st, _, body = _req(s3, "GET", "/b?list-type=2&max-keys=abc")
+    assert st == 400 and b"InvalidArgument" in body
+    # Range starting past EOF -> 416 with the total length
+    st, hdrs, _ = _req(s3, "GET", "/b/k1", headers={"Range": "bytes=10-"})
+    assert st == 416 and hdrs["Content-Range"] == "bytes */1"
     # malformed Range falls back to a full 200 response
     st, _, body = _req(s3, "GET", "/b/k1", headers={"Range": "bytes=abc-"})
     assert st == 200 and body == b"x"

@@ -1,0 +1,57 @@
+"""Native C++ core: byte-identical digests + checksum agreement + speed."""
+
+import os
+
+import numpy as np
+import pytest
+
+from juicefs_tpu import native
+from juicefs_tpu.object.checksum import crc32c_py
+from juicefs_tpu.tpu.jth256 import LANE_BYTES, jth256
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def test_crc32c_matches_python():
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 7, 8, 9, 100, 4096, 1 << 20):
+        data = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        assert native.crc32c(data) == crc32c_py(data)
+    # incremental
+    a, b = os.urandom(1000), os.urandom(1000)
+    assert native.crc32c(b, native.crc32c(a)) == crc32c_py(b, crc32c_py(a))
+
+
+def test_jth256_matches_spec():
+    rng = np.random.default_rng(1)
+    for n in (0, 1, 63, 4096, LANE_BYTES - 1, LANE_BYTES, LANE_BYTES + 1,
+              3 * LANE_BYTES + 17):
+        data = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        assert native.jth256(data) == jth256(data), f"mismatch at n={n}"
+
+
+def test_jth256_batch_matches_and_threads():
+    rng = np.random.default_rng(2)
+    blocks = [
+        rng.integers(0, 256, size=s, dtype=np.uint8).tobytes()
+        for s in (100, LANE_BYTES, 2 * LANE_BYTES + 5, 0, 7)
+    ]
+    ref = [jth256(b) for b in blocks]
+    assert native.jth256_batch(blocks, threads=1) == ref
+    assert native.jth256_batch(blocks, threads=4) == ref
+
+
+def test_native_is_fast():
+    import time
+
+    data = os.urandom(4 << 20)
+    t0 = time.perf_counter()
+    native.crc32c(data)
+    crc_dt = time.perf_counter() - t0
+    assert crc_dt < 0.05, f"native crc32c too slow: {crc_dt*1e3:.1f} ms for 4 MiB"
+    t0 = time.perf_counter()
+    native.jth256(data)
+    h_dt = time.perf_counter() - t0
+    assert h_dt < 0.5, f"native jth256 too slow: {h_dt*1e3:.1f} ms for 4 MiB"
