@@ -85,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
         info,
         mount,
         objbench,
+        stats,
         sync,
         warmup,
     )
@@ -96,7 +97,7 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     for mod in (
         format_cmd, mount, bench, objbench, gc, fsck, sync, dump, warmup,
-        info, gateway,
+        info, gateway, stats,
     ):
         mod.add_parser(sub)
     args = parser.parse_args(argv)

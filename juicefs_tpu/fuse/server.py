@@ -350,9 +350,17 @@ class Server:
         return st if st else self._entry_out(oldnodeid, attr)
 
     def _open(self, ctx, hdr, body):
+        from ..vfs.internal import is_internal
+
         flags, _ = k.OPEN_IN.unpack_from(body)
         st, attr, fh = self.vfs.open(ctx, hdr[1], flags)
-        return st if st else k.OPEN_OUT.pack(fh, 0, 0)
+        if st:
+            return st
+        # Virtual files report length 0 but stream content: DIRECT_IO makes
+        # the kernel read past "EOF" until a short read (reference fuse.go
+        # Open sets FOPEN_DIRECT_IO for internal inodes).
+        open_flags = 0x1 if is_internal(hdr[1]) else 0  # FOPEN_DIRECT_IO
+        return k.OPEN_OUT.pack(fh, open_flags, 0)
 
     def _read(self, ctx, hdr, body):
         fh, offset, size, _rf, _lo, _fl, _ = k.READ_IN.unpack_from(body)

@@ -240,6 +240,9 @@ class S3Gateway(HTTPAdapter):
                 h.send_header("Content-Length", "0")
                 h.end_headers()
                 return
+            if code == 206 and start > end:
+                # syntactically inverted range: unsatisfiable -> ignore
+                start, end, code = 0, attr.length - 1, 200
         with self.fs.open(path) as f:
             data = f.pread(start, end - start + 1) if attr.length else b""
         h.send_response(code)

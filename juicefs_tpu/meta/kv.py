@@ -1038,6 +1038,126 @@ class KVMeta(BaseMeta):
                 indx = int.from_bytes(k[10:14], "big")
                 yield (ino, indx), Slice.decode_list(v)
 
+    def clone(self, ctx: Context, src_ino: int, dst_parent: int, name: bytes) -> tuple[int, int]:
+        """Server-side O(meta) copy of a subtree (reference base.go:2427-2588
+        Clone): duplicate the metadata tree, share data by incref'ing every
+        slice. Returns (errno, new root inode). Runs as one transaction —
+        correct for any size, batched only by the engine's txn capacity."""
+
+        def fn(tx: KVTxn):
+            sattr = self._get_attr(tx, src_ino)
+            if sattr is None:
+                return errno.ENOENT, 0
+            pattr = self._get_attr(tx, dst_parent)
+            if pattr is None:
+                return errno.ENOENT, 0
+            if pattr.typ != TYPE_DIRECTORY:
+                return errno.ENOTDIR, 0
+            typ, _ = self._get_entry(tx, dst_parent, name)
+            if typ:
+                return errno.EEXIST, 0
+
+            # Pass 1: measure the subtree (inodes/space), so the quota
+            # check happens BEFORE any mutation — an errno return does not
+            # roll the txn back, so nothing may be written on failure.
+            count = [0]
+            space = [0]
+            length = [0]
+
+            def count_tree(ino: int) -> None:
+                attr = self._get_attr(tx, ino)
+                if attr is None:
+                    return
+                count[0] += 1
+                space[0] += _align4k(attr.length) + (
+                    4096 if attr.typ == TYPE_DIRECTORY else 0
+                )
+                length[0] += attr.length if attr.typ == TYPE_FILE else 0
+                if attr.typ == TYPE_DIRECTORY:
+                    for _n, _t, child in self._scan_entries(tx, ino):
+                        count_tree(child)
+
+            count_tree(src_ino)
+            if space[0] > 0 and self.fmt.capacity:
+                if self._counter_get(tx, "usedSpace") + space[0] > self.fmt.capacity:
+                    return errno.ENOSPC, 0
+            if self.fmt.inodes:
+                if self._counter_get(tx, "totalInodes") + count[0] > self.fmt.inodes:
+                    return errno.ENOSPC, 0
+            base = tx.incr_by(self._counter_key("nextInode"), count[0]) - count[0]
+            next_ino = [base]
+            now = time.time()
+
+            def copy_tree(old: int, new_parent: int) -> int:
+                attr = self._get_attr(tx, old)
+                if attr is None:
+                    return 0  # dangling entry: skip, like count_tree
+                new = next_ino[0]
+                next_ino[0] += 1
+                nattr = Attr.decode(attr.encode())  # deep copy via codec
+                nattr.parent = new_parent
+                nattr.touch_ctime(now)
+                if nattr.typ == TYPE_DIRECTORY:
+                    nattr.nlink = 2
+                else:
+                    nattr.nlink = 1
+                self._set_attr(tx, new, nattr)
+                # xattrs
+                xprefix = self._ino_key(old) + b"X"
+                for k, v in tx.scan(xprefix, next_key(xprefix)):
+                    tx.set(self._xattr_key(new, k[len(xprefix):]), v)
+                if attr.typ == TYPE_SYMLINK:
+                    target = tx.get(self._symlink_key(old))
+                    if target is not None:
+                        tx.set(self._symlink_key(new), target)
+                elif attr.typ == TYPE_FILE:
+                    cprefix = self._ino_key(old) + b"C"
+                    for k, v in tx.scan(cprefix, next_key(cprefix)):
+                        indx = int.from_bytes(k[len(cprefix):], "big")
+                        tx.set(self._chunk_key(new, indx), v)
+                        for s in Slice.decode_list(v):
+                            if s.id:
+                                self._incref_slice(tx, s.id, s.size)
+                else:  # directory: recurse
+                    nchildren = 0
+                    for cname, ctyp, child in self._scan_entries(tx, old):
+                        cnew = copy_tree(child, new)
+                        if cnew == 0:
+                            continue  # dangling child skipped
+                        self._set_entry(tx, new, cname, ctyp, cnew)
+                        if ctyp == TYPE_DIRECTORY:
+                            nchildren += 1
+                    if nchildren:
+                        nattr.nlink = 2 + nchildren
+                        self._set_attr(tx, new, nattr)
+                    # dirstats are per-directory direct children: the source
+                    # dir's stats apply verbatim to its clone
+                    dstat = tx.get(self._dirstat_key(old))
+                    if dstat is not None:
+                        tx.set(self._dirstat_key(new), dstat)
+                return new
+
+            new_root = copy_tree(src_ino, dst_parent)
+            self._set_entry(tx, dst_parent, name, sattr.typ, new_root)
+            if sattr.typ == TYPE_DIRECTORY:
+                pattr.nlink += 1
+            pattr.touch_mtime(now)
+            self._set_attr(tx, dst_parent, pattr)
+            # quota checked above; only charge the counters here
+            tx.incr_by(self._counter_key("usedSpace"), space[0])
+            tx.incr_by(self._counter_key("totalInodes"), count[0])
+            # dst_parent's dirstat gains only its one new direct child
+            if sattr.typ == TYPE_DIRECTORY:
+                self._update_dirstat(tx, dst_parent, 0, 4096, 1)
+            else:
+                self._update_dirstat(
+                    tx, dst_parent, sattr.length, _align4k(sattr.length), 1
+                )
+            return 0, new_root
+
+        result = self._txn_notify(fn)
+        return result
+
     # ---- xattr -----------------------------------------------------------
     def do_getxattr(self, ino, name) -> tuple[int, bytes]:
         raw = self.client.simple_txn(lambda tx: tx.get(self._xattr_key(ino, name)))

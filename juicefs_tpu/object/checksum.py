@@ -42,12 +42,11 @@ def crc32c_py(data: bytes, crc: int = 0) -> int:
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:
-    """CRC32C via the native library (SSE4.2) with Python fallback."""
+    """CRC32C via the native library (SSE4.2); native falls back to
+    crc32c_py itself when no toolchain is available."""
     from .. import native
 
-    if native.available():
-        return native.crc32c(data, crc)
-    return crc32c_py(data, crc)
+    return native.crc32c(data, crc)
 
 
 class _Checksummed(ObjectStorage):

@@ -1,0 +1,200 @@
+"""Internal virtual files (reference pkg/vfs/internal.go:78-105).
+
+Four virtual inodes live at the volume root, invisible to readdir:
+
+  .control    write a JSON command, read back streamed JSON result
+              (reference writes binary op+args and reads progress
+              frames, internal.go:294 handleInternalMsg — same protocol
+              role, JSON encoding). Ops: info, summary, rmr, warmup,
+              compact, clone.
+  .accesslog  live op trace; lines materialize only while open
+  .stats      point-in-time Prometheus text dump of the registry
+  .config     the volume's runtime VFSConfig + Format as JSON
+
+Inode numbers sit at the top of the 31-bit space like the reference's
+(internal.go MinInternalNode), far above allocated inodes.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import json
+import time
+
+from ..meta.context import Context
+from ..meta.types import Attr, TYPE_FILE
+
+CONTROL_INO = 0x7FFFFFFF
+LOG_INO = 0x7FFFFFFE
+STATS_INO = 0x7FFFFFFD
+CONFIG_INO = 0x7FFFFFFC
+MIN_INTERNAL_INO = CONFIG_INO
+
+INTERNAL_NAMES = {
+    b".control": CONTROL_INO,
+    b".accesslog": LOG_INO,
+    b".stats": STATS_INO,
+    b".config": CONFIG_INO,
+}
+
+
+def internal_attr(ino: int) -> Attr:
+    now = int(time.time())
+    return Attr(
+        typ=TYPE_FILE, mode=0o400 if ino != CONTROL_INO else 0o600,
+        uid=0, gid=0, nlink=1, length=0,
+        atime=now, mtime=now, ctime=now, full=True,
+    )
+
+
+def is_internal(ino: int) -> bool:
+    return ino >= MIN_INTERNAL_INO
+
+
+class ControlHandler:
+    """Executes .control commands against the live mount
+    (reference internal.go handleInternalMsg; consumed by info/rmr/
+    warmup/compact/clone CLIs through the mounted fs)."""
+
+    def __init__(self, vfs):
+        self.vfs = vfs
+
+    def handle(self, ctx: Context, cmd: dict) -> dict:
+        op = cmd.get("op", "")
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            return {"errno": _errno.EINVAL, "error": f"unknown op {op!r}"}
+        try:
+            return fn(ctx, cmd)
+        except Exception as e:  # never kill the mount from a control op
+            return {"errno": _errno.EIO, "error": str(e)}
+
+    def _op_info(self, ctx, cmd):
+        ino = int(cmd["inode"])
+        st, attr = self.vfs.meta.getattr(ctx, ino)
+        if st:
+            return {"errno": st}
+        out = {
+            "errno": 0, "inode": ino, "type": attr.typ, "length": attr.length,
+            "nlink": attr.nlink, "paths": self.vfs.meta.get_paths(ino),
+        }
+        if attr.typ == TYPE_FILE:
+            from ..meta.types import CHUNK_SIZE
+
+            chunks = []
+            for indx in range((attr.length + CHUNK_SIZE - 1) // CHUNK_SIZE):
+                st, slices = self.vfs.meta.read_chunk(ino, indx)
+                if st == 0:
+                    chunks.append([
+                        [s.pos, s.id, s.size, s.off, s.len] for s in slices
+                    ])
+            out["chunks"] = chunks
+        return out
+
+    def _op_summary(self, ctx, cmd):
+        st, s = self.vfs.meta.summary(ctx, int(cmd["inode"]))
+        if st:
+            return {"errno": st}
+        return {"errno": 0, "files": s.files, "dirs": s.dirs,
+                "length": s.length, "size": s.size}
+
+    def _op_rmr(self, ctx, cmd):
+        st, removed = self.vfs.meta.remove_recursive(
+            ctx, int(cmd["parent"]), cmd["name"].encode(),
+            skip_trash=bool(cmd.get("skip_trash")),
+        )
+        return {"errno": st, "removed": removed}
+
+    def _op_warmup(self, ctx, cmd):
+        from ..meta.types import CHUNK_SIZE
+
+        ino = int(cmd["inode"])
+        st, attr = self.vfs.meta.getattr(ctx, ino)
+        if st:
+            return {"errno": st}
+        slices = 0
+        for indx in range((attr.length + CHUNK_SIZE - 1) // CHUNK_SIZE):
+            st, slcs = self.vfs.meta.read_chunk(ino, indx)
+            for s in slcs:
+                if s.id:
+                    self.vfs.store.fill_cache(s.id, s.size)
+                    slices += 1
+        return {"errno": 0, "slices": slices}
+
+    def _op_compact(self, ctx, cmd):
+        from ..meta.types import CHUNK_SIZE
+        from .compact import compact_chunk
+
+        ino = int(cmd["inode"])
+        st, attr = self.vfs.meta.getattr(ctx, ino)
+        if st:
+            return {"errno": st}
+        done = 0
+        for indx in range((attr.length + CHUNK_SIZE - 1) // CHUNK_SIZE):
+            if compact_chunk(self.vfs.meta, self.vfs.store, ino, indx):
+                done += 1
+        return {"errno": 0, "compacted": done}
+
+    def _op_clone(self, ctx, cmd):
+        if not hasattr(self.vfs.meta, "clone"):
+            return {"errno": _errno.ENOSYS}
+        st, new_ino = self.vfs.meta.clone(
+            ctx, int(cmd["inode"]), int(cmd["parent"]), cmd["name"].encode()
+        )
+        return {"errno": st, "inode": new_ino}
+
+
+class InternalFiles:
+    """Open-handle state for the virtual files."""
+
+    def __init__(self, vfs):
+        self.vfs = vfs
+        self.control = ControlHandler(vfs)
+        self._bufs: dict[int, bytes] = {}  # fh -> pending read data
+
+    def lookup(self, name: bytes):
+        ino = INTERNAL_NAMES.get(name)
+        if ino is None:
+            return None
+        return ino, internal_attr(ino)
+
+    def open(self, ino: int, fh: int) -> None:
+        if ino == LOG_INO:
+            self.vfs.accesslog.open_reader(fh)
+        elif ino == STATS_INO:
+            from ..metric import global_registry
+
+            self._bufs[fh] = global_registry().render().encode()
+        elif ino == CONFIG_INO:
+            conf = {
+                "readonly": self.vfs.conf.readonly,
+                "max_readahead": self.vfs.conf.max_readahead,
+                "attr_timeout": self.vfs.conf.attr_timeout,
+            }
+            if self.vfs.fmt is not None:
+                conf["format"] = json.loads(self.vfs.fmt.remove_secret().to_json())
+            self._bufs[fh] = json.dumps(conf, indent=2).encode()
+        else:
+            self._bufs[fh] = b""
+
+    def read(self, ino: int, fh: int, off: int, size: int) -> tuple[int, bytes]:
+        if ino == LOG_INO:
+            return 0, self.vfs.accesslog.read(fh, size)
+        buf = self._bufs.get(fh, b"")
+        return 0, buf[off : off + size]
+
+    def write(self, ctx: Context, ino: int, fh: int, data: bytes) -> int:
+        if ino != CONTROL_INO:
+            return _errno.EACCES
+        try:
+            cmd = json.loads(data)
+        except ValueError:
+            return _errno.EINVAL
+        result = self.control.handle(ctx, cmd)
+        self._bufs[fh] = json.dumps(result).encode()
+        return 0
+
+    def release(self, ino: int, fh: int) -> None:
+        if ino == LOG_INO:
+            self.vfs.accesslog.close_reader(fh)
+        self._bufs.pop(fh, None)

@@ -33,8 +33,11 @@ from ..meta.types import (
     TYPE_DIRECTORY,
     TYPE_FILE,
 )
+from ..metric import global_registry
 from ..utils import get_logger
+from .accesslog import AccessLogger
 from .handles import Handle, HandleTable
+from .internal import INTERNAL_NAMES, InternalFiles, internal_attr, is_internal
 from .reader import DataReader
 from .writer import DataWriter
 
@@ -72,13 +75,74 @@ class VFS:
         self.writer = DataWriter(meta, store)
         self.reader = DataReader(meta, store, self.conf.max_readahead, writer=self.writer)
         self._append_lock = threading.Lock()
+        self.accesslog = AccessLogger()
+        self.internal = InternalFiles(self)
+        self._op_hist = global_registry().histogram(
+            "juicefs_fuse_ops_durations_histogram_seconds",
+            "Operation latencies (reference vfs/accesslog.go:30-46)",
+            ("method",),
+        )
+        self._instrument()
+
+    def _instrument(self) -> None:
+        """Wrap public ops with latency metrics + access logging
+        (reference: every VFS method logit()s, accesslog.go:64)."""
+        import time as _time
+
+        self._op_depth = threading.local()
+
+        for name in (
+            "lookup", "getattr", "setattr", "mknod", "mkdir", "unlink",
+            "rmdir", "rename", "link", "symlink", "readdir", "create",
+            "open", "read", "write", "flush", "fsync", "release",
+            "truncate_ino", "copy_file_range", "statfs",
+        ):
+            orig = getattr(self, name)
+
+            def wrapper(ctx, *a, __orig=orig, __name=name, **kw):
+                # Only the outermost op records: fsync->flush and
+                # O_APPEND-write->getattr are internal self-calls, not
+                # kernel requests (one log line per VFS op, like the
+                # reference).
+                if getattr(self._op_depth, "d", 0) > 0:
+                    return __orig(ctx, *a, **kw)
+                self._op_depth.d = 1
+                t0 = _time.perf_counter()
+                try:
+                    out = __orig(ctx, *a, **kw)
+                finally:
+                    self._op_depth.d = 0
+                dur = _time.perf_counter() - t0
+                self._op_hist.labels(__name).observe(dur)
+                if self.accesslog.active and not (
+                    a and isinstance(a[0], int) and is_internal(a[0])
+                ):
+                    # ops on the virtual files themselves are not logged
+                    # (they would feed the log they are reading)
+                    err = out[0] if isinstance(out, tuple) else out
+                    if not isinstance(err, int):
+                        err = 0
+                    args = ",".join(
+                        str(x) for x in a[:3] if isinstance(x, (int, bytes, str))
+                    )
+                    self.accesslog.logit(
+                        __name, args, err, dur, getattr(ctx, "pid", 0)
+                    )
+                return out
+
+            setattr(self, name, wrapper)
 
     # -- namespace ---------------------------------------------------------
 
     def lookup(self, ctx: Context, parent: int, name: bytes) -> tuple[int, int, Attr]:
+        if parent == ROOT_INO and name in INTERNAL_NAMES:
+            ino, attr = self.internal.lookup(name)
+            return 0, ino, attr
         return self.meta.lookup(ctx, parent, name)
 
     def getattr(self, ctx: Context, ino: int) -> tuple[int, Attr]:
+        if is_internal(ino):
+            return 0, internal_attr(ino)
         st, attr = self.meta.getattr(ctx, ino)
         if st == 0 and attr.typ == TYPE_FILE:
             # Surface buffered writes in stat (reference UpdateLength). Copy
@@ -190,6 +254,10 @@ class VFS:
         return 0, ino, attr, fh
 
     def open(self, ctx: Context, ino: int, flags: int) -> tuple[int, Attr, int]:
+        if is_internal(ino):
+            h = self.handles.new(ino, flags)
+            self.internal.open(ino, h.fh)
+            return 0, internal_attr(ino), h.fh
         accmode = flags & os.O_ACCMODE
         if self.conf.readonly and (
             accmode != os.O_RDONLY or flags & (os.O_TRUNC | os.O_APPEND)
@@ -219,6 +287,8 @@ class VFS:
         h = self.handles.get(fh)
         if h is None or h.ino != ino:
             return _errno.EBADF, b""
+        if is_internal(ino):
+            return self.internal.read(ino, fh, off, size)
         if h.reader is None:
             return _errno.EACCES, b""
         if off >= MAX_FILE_SIZE or size > (64 << 20):
@@ -241,6 +311,8 @@ class VFS:
         h = self.handles.get(fh)
         if h is None or h.ino != ino:
             return _errno.EBADF
+        if is_internal(ino):
+            return self.internal.write(ctx, ino, fh, data)
         if h.writer is None:
             return _errno.EACCES
         if off + len(data) > MAX_FILE_SIZE:
@@ -278,6 +350,9 @@ class VFS:
     def release(self, ctx: Context, ino: int, fh: int) -> int:
         h = self.handles.remove(fh)
         if h is None:
+            return 0
+        if is_internal(ino):
+            self.internal.release(ino, fh)
             return 0
         h.wait_quiet()
         st = 0

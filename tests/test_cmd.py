@@ -280,3 +280,85 @@ def test_format_with_encryption_encrypts_at_rest(tmp_path, capsys):
     st, data = v2.read(CTX, ino, fh, 0, len(secret))
     assert data == secret
     v2.close()
+
+
+def test_clone_and_restore(vol, capsys):
+    meta_url, bucket, tmp = vol
+    v = _open_vfs(meta_url, tmp)
+    st, dino, _ = v.mkdir(CTX, ROOT_INO, b"orig", 0o755)
+    _write_file(v, b"orig/data.bin", None) if False else None
+    st, ino, _, fh = v.create(CTX, dino, b"data.bin", 0o644)
+    v.write(CTX, ino, fh, 0, b"clone me" * 1000)
+    v.release(CTX, ino, fh)
+    v.close()
+    # server-side clone shares slices
+    assert main(["clone", meta_url, "/orig", "/copy"]) == 0
+    capsys.readouterr()
+    v2 = _open_vfs(meta_url, tmp, 1)
+    st, cino, _ = v2.lookup(CTX, ROOT_INO, b"copy")
+    assert st == 0
+    st, fino, _ = v2.lookup(CTX, cino, b"data.bin")
+    st, attr, fh = v2.open(CTX, fino, os.O_RDONLY)
+    st, data = v2.read(CTX, fino, fh, 0, 8)
+    assert data == b"clone me"
+    # deleting the original must not break the clone (slice refcounts)
+    st, n = v2.meta.remove_recursive(CTX, ROOT_INO, b"orig", skip_trash=True)
+    assert st == 0
+    v2.store.cache.clear() if hasattr(v2.store.cache, "clear") else None
+    st, data = v2.read(CTX, fino, fh, 4096, 8)
+    assert st == 0 and len(data) == 8
+    v2.close()
+
+
+def test_trash_and_restore(vol, capsys):
+    meta_url, bucket, tmp = vol
+    v = _open_vfs(meta_url, tmp)
+    _write_file(v, b"doomed.txt", b"save me")
+    assert v.unlink(CTX, ROOT_INO, b"doomed.txt") == 0  # goes to trash
+    st, _, _ = v.lookup(CTX, ROOT_INO, b"doomed.txt")
+    assert st != 0
+    v.close()
+    assert main(["restore", meta_url]) == 0
+    hours = capsys.readouterr().out.strip().splitlines()
+    assert hours and ":" in hours[0]
+    hour = hours[0].split(":")[0]
+    assert main(["restore", meta_url, hour]) == 0
+    assert "restored 1" in capsys.readouterr().out
+    v2 = _open_vfs(meta_url, tmp, 1)
+    st, ino, _ = v2.lookup(CTX, ROOT_INO, b"doomed.txt")
+    assert st == 0
+    st, attr, fh = v2.open(CTX, ino, os.O_RDONLY)
+    st, data = v2.read(CTX, ino, fh, 0, 16)
+    assert data == b"save me"
+    v2.close()
+
+
+def test_internal_files_and_control(vol, capsys):
+    meta_url, bucket, tmp = vol
+    v = _open_vfs(meta_url, tmp)
+    ino = _write_file(v, b"target.bin", b"z" * 5000)
+    # .stats
+    st, _, sfh = v.open(CTX, 0x7FFFFFFD, 0)
+    st, data = v.read(CTX, 0x7FFFFFFD, sfh, 0, 1 << 20)
+    assert b"juicefs_fuse_ops_durations" in data
+    v.release(CTX, 0x7FFFFFFD, sfh)
+    # .control: info + summary + clone ops
+    import json as _json
+    st, ctl_ino, _ = v.lookup(CTX, ROOT_INO, b".control")
+    assert st == 0
+    st, _, cfh = v.open(CTX, ctl_ino, os.O_RDWR)
+    assert v.write(CTX, ctl_ino, cfh, 0, _json.dumps(
+        {"op": "info", "inode": ino}).encode()) == 0
+    st, data = v.read(CTX, ctl_ino, cfh, 0, 1 << 20)
+    info = _json.loads(data)
+    assert info["errno"] == 0 and info["length"] == 5000
+    assert info["paths"] == ["/target.bin"]
+    v.release(CTX, ctl_ino, cfh)
+    # .accesslog materializes ops while open
+    st, log_ino, _ = v.lookup(CTX, ROOT_INO, b".accesslog")
+    st, _, lfh = v.open(CTX, log_ino, os.O_RDONLY)
+    v.getattr(CTX, ino)
+    st, lines = v.read(CTX, log_ino, lfh, 0, 1 << 16)
+    assert b"getattr" in lines
+    v.release(CTX, log_ino, lfh)
+    v.close()
