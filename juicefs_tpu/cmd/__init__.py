@@ -85,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
         info,
         mount,
         objbench,
+        quota,
         stats,
         sync,
         warmup,
@@ -97,7 +98,7 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     for mod in (
         format_cmd, mount, bench, objbench, gc, fsck, sync, dump, warmup,
-        info, gateway, stats,
+        info, gateway, stats, quota,
     ):
         mod.add_parser(sub)
     args = parser.parse_args(argv)

@@ -10,12 +10,10 @@ dump/load pair.
 
 from __future__ import annotations
 
-import base64
 import json
 import sys
 
 from ..meta import new_client
-from ..meta.tkv_client import next_key
 from ..utils import get_logger
 
 logger = get_logger("cmd.dump")
@@ -37,19 +35,11 @@ def add_parser(sub):
 
 
 def run_dump(args) -> int:
+    from ..meta.dump import dump_doc
+
     m = new_client(args.meta_url)
     m.load()
-    records = []
-    for k, v in m.client.scan(b"", b"\xff" * 9):
-        records.append(
-            [base64.b64encode(k).decode(), base64.b64encode(v).decode()]
-        )
-    doc = {
-        "version": 1,
-        "engine": m.name(),
-        "counters": {},
-        "records": records,
-    }
+    doc = dump_doc(m)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     try:
         json.dump(doc, out)
@@ -57,36 +47,20 @@ def run_dump(args) -> int:
     finally:
         if out is not sys.stdout:
             out.close()
-    logger.info("dumped %d records", len(records))
+    logger.info("dumped %d records", len(doc["records"]))
     return 0
 
 
 def run_load(args) -> int:
+    from ..meta.dump import load_doc
+
     src = sys.stdin if args.input == "-" else open(args.input)
     try:
         doc = json.load(src)
     finally:
         if src is not sys.stdin:
             src.close()
-    if doc.get("version") != 1:
-        raise ValueError(f"unsupported dump version {doc.get('version')}")
-
     m = new_client(args.meta_url)
-    existing = next(iter(m.client.scan(b"", b"\xff" * 9)), None)
-    if existing is not None:
-        if not args.force:
-            raise RuntimeError("target meta engine not empty (use --force)")
-        m.client.reset()
-
-    records = [
-        (base64.b64decode(k), base64.b64decode(v)) for k, v in doc["records"]
-    ]
-
-    def fn(tx):
-        for k, v in records:
-            tx.set(k, v)
-        return 0
-
-    m.client.txn(fn)
-    print(f"loaded {len(records)} records into {args.meta_url}")
+    n = load_doc(m, doc, force=args.force)
+    print(f"loaded {n} records into {args.meta_url}")
     return 0

@@ -28,6 +28,8 @@ def add_parser(sub):
     p.add_argument("--cache-size", default=0, type=int, help="cache size MiB")
     p.add_argument("--writeback", action="store_true")
     p.add_argument("--max-readahead", type=int, default=8, help="MiB")
+    p.add_argument("--no-bgjobs", action="store_true",
+                   help="disable background maintenance on this mount")
     p.set_defaults(func=run)
 
     u = sub.add_parser("umount", help="unmount a volume")
@@ -41,6 +43,10 @@ def serve(args) -> int:
     from ..vfs import VFS, VFSConfig
     from . import build_store, open_meta
 
+    from ..meta import interface as meta_interface
+    from ..vfs.backup import BackgroundJobs
+    from ..vfs.compact import compact_chunk
+
     m, fmt = open_meta(args.meta_url)
     m.new_session(heartbeat=12.0)
     store = build_store(fmt, args)
@@ -50,6 +56,17 @@ def serve(args) -> int:
         VFSConfig(readonly=args.readonly, max_readahead=args.max_readahead << 20),
         fmt,
     )
+    # message handlers (reference registerMetaMsg cmd/mount.go:271):
+    # zero-ref slices delete their blocks; hot chunks compact in background
+    m.on_msg(meta_interface.DELETE_SLICE, lambda sid, size: store.remove(sid, size))
+    m.on_msg(
+        meta_interface.COMPACT_CHUNK,
+        lambda ino, indx: compact_chunk(m, store, ino, indx),
+    )
+    bg = None
+    if not args.no_bgjobs and not args.readonly:
+        bg = BackgroundJobs(m, store)
+        bg.start()
     srv = Server(vfs, args.mountpoint, fsname=f"juicefs-tpu:{fmt.name}",
                  allow_other=args.allow_other)
     srv.mount()
@@ -63,6 +80,8 @@ def serve(args) -> int:
     try:
         srv.serve()
     finally:
+        if bg is not None:
+            bg.stop()
         vfs.close()
         m.close_session()
     return 0

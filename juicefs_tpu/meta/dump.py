@@ -1,0 +1,42 @@
+"""Metadata dump/load document format (reference pkg/meta/dump.go).
+
+A complete, engine-portable snapshot: every ordered-KV record (base64)
+plus a version header — the analog of the reference's `dump --fast`
+binary backup. Consumed by the dump/load CLIs and the automatic
+metadata backup (vfs/backup.py equivalent).
+"""
+
+from __future__ import annotations
+
+import base64
+
+DUMP_VERSION = 1
+
+
+def dump_doc(meta) -> dict:
+    records = [
+        [base64.b64encode(k).decode(), base64.b64encode(v).decode()]
+        for k, v in meta.client.scan(b"", b"\xff" * 9)
+    ]
+    return {"version": DUMP_VERSION, "engine": meta.name(), "records": records}
+
+
+def load_doc(meta, doc: dict, force: bool = False) -> int:
+    if doc.get("version") != DUMP_VERSION:
+        raise ValueError(f"unsupported dump version {doc.get('version')}")
+    existing = next(iter(meta.client.scan(b"", b"\xff" * 9)), None)
+    if existing is not None:
+        if not force:
+            raise RuntimeError("target meta engine not empty (use force)")
+        meta.client.reset()
+    records = [
+        (base64.b64decode(k), base64.b64decode(v)) for k, v in doc["records"]
+    ]
+
+    def fn(tx):
+        for k, v in records:
+            tx.set(k, v)
+        return 0
+
+    meta.client.txn(fn)
+    return len(records)

@@ -208,12 +208,16 @@ class KVMeta(BaseMeta):
         return out
 
     def _update_dirstat(self, tx: KVTxn, ino: int, dl: int, ds: int, di: int) -> None:
-        if not self.fmt.dir_stats or ino == 0:
+        if ino == 0:
             return
-        key = self._dirstat_key(ino)
-        raw = tx.get(key)
-        l, s, i = struct.unpack(">qqq", raw) if raw else (0, 0, 0)
-        tx.set(key, struct.pack(">qqq", l + dl, s + ds, i + di))
+        if self.fmt.dir_stats:
+            key = self._dirstat_key(ino)
+            raw = tx.get(key)
+            l, s, i = struct.unpack(">qqq", raw) if raw else (0, 0, 0)
+            tx.set(key, struct.pack(">qqq", l + dl, s + ds, i + di))
+        # dir quota usage propagates up the ancestor chain regardless of
+        # the dir_stats toggle (reference quota.go update path)
+        self._quota_update(tx, ino, ds, di)
 
     def _update_used(self, tx: KVTxn, dspace: int, dinodes: int) -> int:
         """Global usage counters + volume quota check (reference quota.go)."""
@@ -463,6 +467,9 @@ class KVMeta(BaseMeta):
             if etyp:
                 return errno.EEXIST, 0, Attr()
             st = self._update_used(tx, _align4k(0) + (4096 if typ == TYPE_DIRECTORY else 0), 1)
+            if st:
+                return st, 0, Attr()
+            st = self._quota_check(tx, parent, 4096 if typ == TYPE_DIRECTORY else 0, 1)
             if st:
                 return st, 0, Attr()
             now = time.time()
@@ -870,9 +877,6 @@ class KVMeta(BaseMeta):
                 return errno.ENOENT
             if attr.typ != TYPE_FILE:
                 return errno.EPERM
-            if incref and slc.id:
-                # sharing an existing slice (copy_file_range/clone): bump refs
-                self._incref_slice(tx, slc.id, slc.size)
             now = time.time()
             if length_hint > attr.length:
                 delta = _align4k(length_hint) - _align4k(attr.length)
@@ -880,9 +884,18 @@ class KVMeta(BaseMeta):
                     st = self._update_used(tx, delta, 0)
                     if st:
                         return st
+                    if attr.parent:
+                        st = self._quota_check(tx, attr.parent, delta, 0)
+                        if st:
+                            return st
                 if attr.parent:
                     self._update_dirstat(tx, attr.parent, length_hint - attr.length, delta, 0)
                 attr.length = length_hint
+            if incref and slc.id:
+                # sharing an existing slice (copy_file_range/clone): bump
+                # refs — after the quota/space checks so a rejected write
+                # leaves no stray reference
+                self._incref_slice(tx, slc.id, slc.size)
             attr.touch_mtime(now)
             self._set_attr(tx, ino, attr)
             data = tx.append(self._chunk_key(ino, indx), slc.encode())
@@ -1038,6 +1051,87 @@ class KVMeta(BaseMeta):
                 indx = int.from_bytes(k[10:14], "big")
                 yield (ino, indx), Slice.decode_list(v)
 
+    # ---- dir quotas (reference pkg/meta/quota.go:32-44,209,396) ----------
+    _QFMT = struct.Struct(">qqqq")  # space_limit inode_limit used_space used_inodes
+
+    def _quota_chain(self, tx: KVTxn, dir_ino: int):
+        """Yield (ino, record) for every quota on the ancestor chain."""
+        ino, hops = dir_ino, 0
+        while ino and hops < 100:
+            raw = tx.get(self._dirquota_key(ino))
+            if raw:
+                yield ino, raw
+            if ino == ROOT_INODE:
+                break
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                break
+            ino = attr.parent
+            hops += 1
+
+    def _quota_check(self, tx: KVTxn, dir_ino: int, dspace: int, dinodes: int) -> int:
+        """Reject growth that would exceed any ancestor quota. Must run
+        BEFORE mutations (errno returns do not roll back the txn)."""
+        if dspace <= 0 and dinodes <= 0:
+            return 0
+        for _ino, raw in self._quota_chain(tx, dir_ino):
+            sl, il, us, ui = self._QFMT.unpack(raw)
+            if sl and dspace > 0 and us + dspace > sl:
+                return errno.EDQUOT
+            if il and dinodes > 0 and ui + dinodes > il:
+                return errno.EDQUOT
+        return 0
+
+    def _quota_update(self, tx: KVTxn, dir_ino: int, dspace: int, dinodes: int) -> None:
+        if not dspace and not dinodes:
+            return
+        for ino, raw in self._quota_chain(tx, dir_ino):
+            sl, il, us, ui = self._QFMT.unpack(raw)
+            tx.set(
+                self._dirquota_key(ino),
+                self._QFMT.pack(sl, il, us + dspace, ui + dinodes),
+            )
+
+    def set_dir_quota(self, ctx: Context, ino: int, space_limit: int, inode_limit: int) -> int:
+        """Set/replace a directory quota; current usage is initialized from
+        a tree walk (reference HandleQuota quota.go:396)."""
+        st, summ = self.summary(ctx, ino)
+        if st:
+            return st
+        # usage counts the subtree below the quota dir, not the dir itself
+        used_space = max(0, summ.size - 4096)
+        used_inodes = summ.files + summ.dirs - 1
+
+        def fn(tx: KVTxn):
+            if self._get_attr(tx, ino) is None:
+                return errno.ENOENT
+            tx.set(
+                self._dirquota_key(ino),
+                self._QFMT.pack(space_limit, inode_limit, used_space, used_inodes),
+            )
+            return 0
+
+        return self.client.txn(fn)
+
+    def get_dir_quota(self, ino: int):
+        raw = self.client.simple_txn(lambda tx: tx.get(self._dirquota_key(ino)))
+        if raw is None:
+            return None
+        return self._QFMT.unpack(raw)
+
+    def del_dir_quota(self, ino: int) -> int:
+        def fn(tx: KVTxn):
+            tx.delete(self._dirquota_key(ino))
+            return 0
+
+        return self.client.txn(fn)
+
+    def list_dir_quotas(self) -> dict[int, tuple[int, int, int, int]]:
+        out = {}
+        for k, v in self.client.scan(b"QD", next_key(b"QD")):
+            out[int.from_bytes(k[2:10], "big")] = self._QFMT.unpack(v)
+        return out
+
     def clone(self, ctx: Context, src_ino: int, dst_parent: int, name: bytes) -> tuple[int, int]:
         """Server-side O(meta) copy of a subtree (reference base.go:2427-2588
         Clone): duplicate the metadata tree, share data by incref'ing every
@@ -1084,6 +1178,9 @@ class KVMeta(BaseMeta):
             if self.fmt.inodes:
                 if self._counter_get(tx, "totalInodes") + count[0] > self.fmt.inodes:
                     return errno.ENOSPC, 0
+            st = self._quota_check(tx, dst_parent, space[0], count[0])
+            if st:
+                return st, 0
             base = tx.incr_by(self._counter_key("nextInode"), count[0]) - count[0]
             next_ino = [base]
             now = time.time()

@@ -1,0 +1,140 @@
+"""Automatic metadata backup + background maintenance loop.
+
+Reference pkg/vfs/backup.go:45-192 (periodic meta dump to the object
+store under meta/ with rotation, interval scaled by file count) and
+base.go:440's per-session cleanup goroutines (deleted-file reclaim,
+stale-session GC, trash expiry). One mount runs these; the reference
+elects a single winner per volume — here the election is a best-effort
+object-store lock file refreshed each round.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import time
+
+from ..meta.context import BACKGROUND
+from ..meta.dump import dump_doc
+from ..meta.types import TRASH_INODE
+from ..utils import get_logger
+
+logger = get_logger("vfs.backup")
+
+BACKUP_PREFIX = "meta/"
+KEEP_BACKUPS = 7
+
+
+def backup_meta(meta, storage) -> str:
+    """Dump metadata, gzip it, store under meta/, rotate old backups."""
+    doc = dump_doc(meta)
+    payload = gzip.compress(json.dumps(doc).encode())
+    key = BACKUP_PREFIX + time.strftime("dump-%Y-%m-%d-%H%M%S.json.gz", time.gmtime())
+    storage.put(key, payload)
+    backups = sorted(
+        o.key for o in storage.list_all(BACKUP_PREFIX) if o.key.endswith(".json.gz")
+    )
+    for old in backups[:-KEEP_BACKUPS]:
+        try:
+            storage.delete(old)
+        except Exception as e:
+            logger.warning("rotate %s: %s", old, e)
+    return key
+
+
+def cleanup_trash(meta, days: float) -> int:
+    """Expire trash hour-dirs older than `days` (reference base.go:2281
+    CleanupTrashBefore). Returns entries removed."""
+    import calendar
+
+    st, entries = meta.readdir(BACKGROUND, TRASH_INODE)
+    if st:
+        return 0
+    cutoff = time.time() - days * 86400
+    removed = 0
+    for e in entries:
+        if e.name in (b".", b".."):
+            continue
+        try:
+            ts = calendar.timegm(time.strptime(e.name.decode(), "%Y-%m-%d-%H"))
+        except ValueError:
+            continue
+        if ts + 3600 < cutoff:
+            st, n = meta.remove_recursive(
+                BACKGROUND, TRASH_INODE, e.name, skip_trash=True
+            )
+            removed += n
+    return removed
+
+
+class BackgroundJobs:
+    """Per-mount maintenance loop (reference base.go:440 refreshSession's
+    bgjob half + initBackgroundTasks cmd/mount.go:357)."""
+
+    def __init__(self, meta, store, interval: float = 60.0,
+                 backup_interval: float = 3600.0):
+        self.meta = meta
+        self.store = store
+        self.interval = interval
+        self.backup_interval = backup_interval
+        self._stop = threading.Event()
+        self._last_backup = 0.0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="vfs-bgjobs"
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _elect(self) -> bool:
+        """Best-effort single-winner election via a lease object."""
+        key = "meta/bgjob-lease"
+        now = time.time()
+        try:
+            raw = bytes(self.store.storage.get(key))
+            holder = json.loads(raw)
+            if holder["sid"] != self.meta.sid and now - holder["ts"] < 5 * self.interval:
+                return False
+        except Exception:
+            pass
+        try:
+            self.store.storage.put(
+                key, json.dumps({"sid": self.meta.sid, "ts": now}).encode()
+            )
+            return True
+        except Exception:
+            return False
+
+    def run_once(self) -> dict:
+        stats = {}
+        try:
+            stats["deleted_files"] = self.meta.cleanup_deleted_files()
+        except Exception as e:
+            logger.warning("cleanup deleted files: %s", e)
+        try:
+            stats["stale_sessions"] = self.meta.clean_stale_sessions()
+        except Exception as e:
+            logger.warning("clean stale sessions: %s", e)
+        try:
+            days = self.meta.fmt.trash_days
+            if days > 0:
+                stats["trash_expired"] = cleanup_trash(self.meta, days)
+        except Exception as e:
+            logger.warning("trash cleanup: %s", e)
+        now = time.time()
+        if now - self._last_backup >= self.backup_interval:
+            try:
+                stats["backup"] = backup_meta(self.meta, self.store.storage)
+                self._last_backup = now
+            except Exception as e:
+                logger.warning("meta backup: %s", e)
+        return stats
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            if self._elect():
+                self.run_once()
