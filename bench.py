@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -31,11 +33,57 @@ import numpy as np
 TARGET_GIBS_PER_CHIP = 10.0 / 8
 
 
+def _probe_default_backend(timeout: float = 120.0, attempts: int = 2):
+    """Ask a subprocess whether the default JAX backend can initialize.
+
+    Round 1 lost its headline number because the ambient TPU relay hung
+    inside backend init before bench printed anything (VERDICT.md weak #1).
+    Probing in a child process means a hang or UNAVAILABLE error can never
+    take down the bench: on failure we pin this process to the CPU XLA
+    backend *before* the first in-process jax import and still emit the
+    JSON line, tagged with the backend that actually ran.
+    """
+    code = (
+        "import jax\n"
+        "d = jax.devices()\n"
+        "print(jax.default_backend(), len(d))\n"
+    )
+    for _ in range(attempts):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            continue
+        if p.returncode == 0 and p.stdout.strip():
+            # parse only the last line: plugin init may chat on stdout
+            toks = p.stdout.strip().splitlines()[-1].split()
+            if len(toks) >= 2 and toks[-1].isdigit():
+                return toks[-2], int(toks[-1])
+        time.sleep(2.0)
+    return None, 0
+
+
+def _pin_cpu_backend() -> None:
+    """Force the CPU XLA backend (must run before the first jax import)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gib", type=float, default=8.0, help="GiB to scan")
     ap.add_argument("--batch", type=int, default=32, help="blocks per device batch")
     ap.add_argument("--backend", default="xla", choices=["xla", "pallas", "cpu"])
+    ap.add_argument(
+        "--probe-timeout", type=float, default=120.0,
+        help="seconds to wait for accelerator backend init before CPU fallback",
+    )
     args = ap.parse_args()
 
     from juicefs_tpu.tpu.jth256 import (
@@ -71,6 +119,13 @@ def main() -> int:
         }))
         return 0
 
+    if os.environ.get("JFS_BENCH_CPU_RETRY") or os.environ.get("JAX_PLATFORMS") == "cpu":
+        _pin_cpu_backend()  # answer predetermined: skip the probe subprocess
+    else:
+        backend_name, _n_dev = _probe_default_backend(timeout=args.probe_timeout)
+        if backend_name is None:
+            _pin_cpu_backend()
+
     import jax
 
     from juicefs_tpu.tpu.dedup import dedup_scan_jax, scan_step_jax
@@ -84,6 +139,28 @@ def main() -> int:
             return d, dup, first
     else:
         step = scan_step_jax
+
+    try:
+        return _device_bench(args, jax, step, rng, b, m, batch_bytes)
+    except Exception as exc:  # transient relay errors (e.g. UNAVAILABLE)
+        if os.environ.get("JFS_BENCH_CPU_RETRY"):
+            raise
+        # Fresh process pinned to CPU: the device run died mid-flight and
+        # the current process may hold a wedged backend.
+        env = dict(os.environ, JFS_BENCH_CPU_RETRY="1", JAX_PLATFORMS="cpu")
+        print(f"device bench failed ({exc!r}); retrying on CPU XLA", file=sys.stderr)
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)]
+                           + sys.argv[1:], env=env)
+        return p.returncode
+
+
+def _device_bench(args, jax, step, rng, b, m, batch_bytes) -> int:
+    from juicefs_tpu.tpu.jth256 import (
+        BLOCK_BYTES,
+        digests_to_bytes,
+        jth256,
+        pack_blocks,
+    )
 
     # Correctness gate: a transferred batch must match the numpy reference.
     blocks = [

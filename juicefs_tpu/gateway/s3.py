@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import errno as _errno
 import posixpath
+import re
 import urllib.parse
 import uuid
 from xml.sax.saxutils import escape
@@ -360,8 +361,22 @@ class S3Gateway(HTTPAdapter):
 
     # -- multipart ---------------------------------------------------------
 
+    _UPLOAD_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+
     def _mp_dir(self, upload_id: str) -> str:
+        # uploadId is attacker-controlled: only the exact uuid4-hex shape
+        # generated by _create_multipart may reach the path join, else
+        # '../' ids escape /.sys/multipart (bypassing the _obj_path guard)
+        if not self._UPLOAD_ID_RE.fullmatch(upload_id):
+            raise ValueError("invalid upload id")
         return f"{SYS_MULTIPART}/{upload_id}"
+
+    def _check_upload_id(self, h, upload_id: str) -> bool:
+        if not self._UPLOAD_ID_RE.fullmatch(upload_id):
+            h._body()  # drain: an unread body desyncs the keep-alive stream
+            h._error(404, "NoSuchUpload", "invalid upload id")
+            return False
+        return True
 
     def _create_multipart(self, h, bucket: str, key: str):
         self.fs.stat("/" + bucket)
@@ -375,12 +390,16 @@ class S3Gateway(HTTPAdapter):
                     f"</InitiateMultipartUploadResult>")
 
     def _upload_part(self, h, bucket: str, key: str, upload_id: str, num: int):
+        if not self._check_upload_id(h, upload_id):
+            return
         data = h._body()
         part = f"{self._mp_dir(upload_id)}/{num:05d}"
         self.fs.write_file(part, data)
         h._empty(200, {"ETag": f'"{_etag(data)}"'})
 
     def _complete_multipart(self, h, bucket: str, key: str, upload_id: str):
+        if not self._check_upload_id(h, upload_id):
+            return
         h._body()  # part manifest; we assemble all uploaded parts in order
         mp = self._mp_dir(upload_id)
         names = sorted(
@@ -408,6 +427,8 @@ class S3Gateway(HTTPAdapter):
                     f"</CompleteMultipartUploadResult>")
 
     def _abort_multipart(self, h, bucket: str, key: str, upload_id: str):
+        if not self._check_upload_id(h, upload_id):
+            return
         try:
             self.fs.remove_all(self._mp_dir(upload_id))
         except FSError:

@@ -78,6 +78,17 @@ def _align4k(length: int) -> int:
     return (length + 4095) // 4096 * 4096 if length else 0
 
 
+def _direct_space(attr: "Attr") -> int:
+    """Space one inode itself charges: dir flat 4096, file/symlink its
+    4k-aligned length (symmetric with mknod/unlink accounting)."""
+    return 4096 if attr.typ == TYPE_DIRECTORY else _align4k(attr.length)
+
+
+def _direct_len(attr: "Attr") -> int:
+    """Byte-length contribution to the parent's dirstat (dirs count 0)."""
+    return 0 if attr.typ == TYPE_DIRECTORY else attr.length
+
+
 class KVMeta(BaseMeta):
     """Meta engine over any TKVClient (reference pkg/meta/tkv.go kvMeta)."""
 
@@ -90,6 +101,29 @@ class KVMeta(BaseMeta):
         return self.client.name
 
     # ---- transactions with post-commit notifications ---------------------
+    def _etxn(self, fn):
+        """Write transaction under the errno convention: `fn` returns an int
+        errno or an (errno, ...) tuple, and a nonzero errno DISCARDS the
+        buffered writes. This mirrors the reference, where a do_* closure
+        returning an error aborts the backend transaction (pkg/meta/tkv.go
+        txn commits only on nil error) — so mutate-then-fail paths (e.g.
+        counter bumps before a quota rejection) can never leak state.
+
+        When called inside an enclosing transaction we join it unwrapped:
+        the outermost owner decides commit/abort from its own return.
+        """
+        if self.client.in_txn():
+            return self.client.txn(fn)
+
+        def wrapped(tx):
+            r = fn(tx)
+            st = r if isinstance(r, int) else (r[0] if isinstance(r, tuple) and r else 0)
+            if isinstance(st, int) and st:
+                tx.discard()
+            return r
+
+        return self.client.txn(wrapped)
+
     def _txn_notify(self, fn):
         """Run a transaction whose body may queue DELETE_SLICE/COMPACT_CHUNK
         messages; fire them only after a successful commit so callbacks never
@@ -103,7 +137,7 @@ class KVMeta(BaseMeta):
                 del msgs[:]  # retry: drop notifications from the failed attempt
                 return fn(tx)
 
-            result = self.client.txn(wrapped)
+            result = self._etxn(wrapped)
         except BaseException:
             del msgs[:]
             raise
@@ -307,7 +341,7 @@ class KVMeta(BaseMeta):
             tx.set(self._heartbeat_key(sid), _F64.pack(time.time()))
             return sid
 
-        return self.client.txn(fn)
+        return self.client.txn(fn)  # returns sid, not errno: no _etxn
 
     def do_refresh_session(self, sid: int) -> None:
         self.client.txn(lambda tx: tx.set(self._heartbeat_key(sid), _F64.pack(time.time())))
@@ -431,7 +465,7 @@ class KVMeta(BaseMeta):
                 self._set_attr(tx, ino, attr)
             return 0, attr
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     # ---- namespace -------------------------------------------------------
     def do_lookup(self, parent: int, name: bytes) -> tuple[int, int, Attr]:
@@ -466,10 +500,19 @@ class KVMeta(BaseMeta):
             etyp, _ = self._get_entry(tx, parent, name)
             if etyp:
                 return errno.EEXIST, 0, Attr()
-            st = self._update_used(tx, _align4k(0) + (4096 if typ == TYPE_DIRECTORY else 0), 1)
+            # initial space: dir 4096, symlink its aligned target length
+            # (unlink releases _align4k(length) — charges must be symmetric),
+            # file 0 (growth is charged by write/truncate deltas)
+            if typ == TYPE_DIRECTORY:
+                ispace = 4096
+            elif typ == TYPE_SYMLINK:
+                ispace = _align4k(len(path))
+            else:
+                ispace = 0
+            st = self._update_used(tx, ispace, 1)
             if st:
                 return st, 0, Attr()
-            st = self._quota_check(tx, parent, 4096 if typ == TYPE_DIRECTORY else 0, 1)
+            st = self._quota_check(tx, parent, ispace, 1)
             if st:
                 return st, 0, Attr()
             now = time.time()
@@ -494,10 +537,12 @@ class KVMeta(BaseMeta):
                 pattr.nlink += 1
             pattr.touch_mtime(now)
             self._set_attr(tx, parent, pattr)
-            self._update_dirstat(tx, parent, 0, 4096 if typ == TYPE_DIRECTORY else 0, 1)
+            self._update_dirstat(
+                tx, parent, attr.length if typ != TYPE_DIRECTORY else 0, ispace, 1
+            )
             return 0, ino, attr
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def _trash_entry(self, tx: KVTxn, parent: int, name: bytes, ino: int, typ: int) -> None:
         """Move a doomed entry under the hourly trash dir
@@ -585,7 +630,7 @@ class KVMeta(BaseMeta):
             self._update_used(tx, -_align4k(attr.length), -1)
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def do_rmdir(self, ctx, parent, name, skip_trash=False) -> int:
         trash = self.fmt.trash_days > 0 and not skip_trash and parent < TRASH_INODE
@@ -623,7 +668,7 @@ class KVMeta(BaseMeta):
             self._update_used(tx, -4096, -1)
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def do_rename(self, ctx, psrc, nsrc, pdst, ndst, flags) -> tuple[int, int, Attr]:
         if flags & ~(RENAME_NOREPLACE | RENAME_EXCHANGE):
@@ -659,12 +704,45 @@ class KVMeta(BaseMeta):
             now = time.time()
             if dino and flags & RENAME_NOREPLACE:
                 return errno.EEXIST, 0, Attr()
+            # Cross-directory moves shift usage between quota trees: measure
+            # the moved subtree; the EDQUOT check runs below once a replaced
+            # destination's credit is known (errno discards the txn).
+            squota = dquota = None
+            move_space = move_inodes = 0
+            if psrc != pdst:
+                squota = self._quota_roots(tx, psrc)
+                dquota = self._quota_roots(tx, pdst)
+                if (squota or dquota) and not flags & RENAME_EXCHANGE:
+                    if styp == TYPE_DIRECTORY:
+                        move_space, move_inodes = self._tree_usage(tx, sino)
+                    else:
+                        move_space, move_inodes = _align4k(sattr.length), 1
             if flags & RENAME_EXCHANGE:
                 if dino == 0:
                     return errno.ENOENT, 0, Attr()
                 dattr = self._get_attr(tx, dino)
                 if dattr is None:
                     return errno.ENOENT, 0, Attr()
+                s_direct = _direct_space(sattr)
+                d_direct = _direct_space(dattr)
+                if psrc != pdst and (squota or dquota):
+                    s_space, s_inodes = (
+                        self._tree_usage(tx, sino)
+                        if styp == TYPE_DIRECTORY
+                        else (s_direct, 1)
+                    )
+                    d_space, d_inodes = (
+                        self._tree_usage(tx, dino)
+                        if dtyp == TYPE_DIRECTORY
+                        else (d_direct, 1)
+                    )
+                    st = self._quota_check_roots(
+                        tx, dquota - squota, s_space - d_space, s_inodes - d_inodes
+                    ) or self._quota_check_roots(
+                        tx, squota - dquota, d_space - s_space, d_inodes - s_inodes
+                    )
+                    if st:
+                        return st, 0, Attr()
                 self._set_entry(tx, psrc, nsrc, dtyp, dino)
                 self._set_entry(tx, pdst, ndst, styp, sino)
                 sattr.parent, dattr.parent = pdst, psrc
@@ -684,6 +762,18 @@ class KVMeta(BaseMeta):
                 if psrc != pdst:
                     dpattr.touch_mtime(now)
                     self._set_attr(tx, pdst, dpattr)
+                    ssz = _direct_len(sattr)
+                    dsz = _direct_len(dattr)
+                    self._update_dirstat(tx, psrc, dsz - ssz, d_direct - s_direct, 0)
+                    self._update_dirstat(tx, pdst, ssz - dsz, s_direct - d_direct, 0)
+                    if squota or dquota:
+                        # subtrees below the swapped roots are invisible to
+                        # the dirstat delta; transfer them explicitly
+                        extra_s = (d_space - d_direct) - (s_space - s_direct)
+                        extra_i = d_inodes - s_inodes
+                        if extra_s or extra_i:
+                            self._quota_update(tx, psrc, extra_s, extra_i)
+                            self._quota_update(tx, pdst, -extra_s, -extra_i)
                 return 0, sino, sattr
             if dino:
                 dattr = self._get_attr(tx, dino)
@@ -698,6 +788,15 @@ class KVMeta(BaseMeta):
                     return errno.EACCES, 0, Attr()
                 # replace: dst loses its entry (goes to trash / delfiles)
                 st = self._free_entry(tx, pdst, ndst, dtyp, dino, dattr, now)
+                if st:
+                    return st, 0, Attr()
+            if psrc != pdst and (squota or dquota):
+                # checked AFTER _free_entry: a replaced destination already
+                # released its usage in this txn, so a net-zero replace
+                # never EDQUOTs (errno returns discard the txn)
+                st = self._quota_check_roots(
+                    tx, dquota - squota, move_space, move_inodes
+                )
                 if st:
                     return st, 0, Attr()
             tx.delete(self._entry_key(psrc, nsrc))
@@ -719,12 +818,20 @@ class KVMeta(BaseMeta):
             if psrc != pdst:
                 dpattr.touch_mtime(now)
                 self._set_attr(tx, pdst, dpattr)
-            dsz = sattr.length if styp == TYPE_FILE else 0
-            self._update_dirstat(tx, psrc, -dsz, -(_align4k(dsz) if styp == TYPE_FILE else 4096), -1)
-            self._update_dirstat(tx, pdst, dsz, _align4k(dsz) if styp == TYPE_FILE else 4096, 1)
+            dsz = _direct_len(sattr)
+            dspace = _direct_space(sattr)
+            self._update_dirstat(tx, psrc, -dsz, -dspace, -1)
+            self._update_dirstat(tx, pdst, dsz, dspace, 1)
+            if styp == TYPE_DIRECTORY and psrc != pdst and (squota or dquota):
+                # the subtree below the moved dir is invisible to the
+                # dirstat delta; transfer it between the quota chains
+                extra_s, extra_i = move_space - 4096, move_inodes - 1
+                if extra_s or extra_i:
+                    self._quota_update(tx, psrc, -extra_s, -extra_i)
+                    self._quota_update(tx, pdst, extra_s, extra_i)
             return 0, sino, sattr
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def _free_entry(self, tx: KVTxn, parent: int, name: bytes, typ: int, ino: int, attr, now) -> int:
         """Drop the entry at (parent, name) whose inode is being replaced."""
@@ -800,7 +907,7 @@ class KVMeta(BaseMeta):
             self._update_dirstat(tx, parent, attr.length, _align4k(attr.length), 1)
             return 0, attr
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def do_readdir(self, ctx, ino, want_attr) -> tuple[int, list[Entry]]:
         def fn(tx: KVTxn):
@@ -920,6 +1027,10 @@ class KVMeta(BaseMeta):
                 st = self._update_used(tx, delta, 0)
                 if st:
                     return st, Attr()
+                if attr.parent:
+                    st = self._quota_check(tx, attr.parent, delta, 0)
+                    if st:
+                        return st, Attr()
             elif delta < 0:
                 self._update_used(tx, delta, 0)
             if attr.parent:
@@ -968,6 +1079,10 @@ class KVMeta(BaseMeta):
                     st = self._update_used(tx, delta, 0)
                     if st:
                         return st
+                    if attr.parent:
+                        st = self._quota_check(tx, attr.parent, delta, 0)
+                        if st:
+                            return st
                 if attr.parent:
                     self._update_dirstat(tx, attr.parent, off + size - length, max(delta, 0), 0)
                 attr.length = off + size
@@ -985,7 +1100,7 @@ class KVMeta(BaseMeta):
             self._set_attr(tx, ino, attr)
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def _incref_slice(self, tx: KVTxn, sid: int, size: int) -> None:
         """Add one reference to a stored slice (reference tkv.go sliceRef:
@@ -1070,17 +1185,12 @@ class KVMeta(BaseMeta):
             hops += 1
 
     def _quota_check(self, tx: KVTxn, dir_ino: int, dspace: int, dinodes: int) -> int:
-        """Reject growth that would exceed any ancestor quota. Must run
-        BEFORE mutations (errno returns do not roll back the txn)."""
+        """Reject growth that would exceed any ancestor quota."""
         if dspace <= 0 and dinodes <= 0:
             return 0
-        for _ino, raw in self._quota_chain(tx, dir_ino):
-            sl, il, us, ui = self._QFMT.unpack(raw)
-            if sl and dspace > 0 and us + dspace > sl:
-                return errno.EDQUOT
-            if il and dinodes > 0 and ui + dinodes > il:
-                return errno.EDQUOT
-        return 0
+        return self._quota_check_roots(
+            tx, self._quota_roots(tx, dir_ino), dspace, dinodes
+        )
 
     def _quota_update(self, tx: KVTxn, dir_ino: int, dspace: int, dinodes: int) -> None:
         if not dspace and not dinodes:
@@ -1091,6 +1201,43 @@ class KVMeta(BaseMeta):
                 self._dirquota_key(ino),
                 self._QFMT.pack(sl, il, us + dspace, ui + dinodes),
             )
+
+    def _quota_roots(self, tx: KVTxn, dir_ino: int) -> set[int]:
+        return {ino for ino, _ in self._quota_chain(tx, dir_ino)}
+
+    def _quota_check_roots(self, tx: KVTxn, roots: set[int], dspace: int, dinodes: int) -> int:
+        """_quota_check over an explicit set of quota roots. Rename uses it
+        so only quotas the destination chain ADDS can reject a move — a
+        quota shared by both chains sees no net usage change (reference
+        pkg/meta/quota.go rename handling)."""
+        if dspace <= 0 and dinodes <= 0:
+            return 0
+        for ino in roots:
+            raw = tx.get(self._dirquota_key(ino))
+            if not raw:
+                continue
+            sl, il, us, ui = self._QFMT.unpack(raw)
+            if sl and dspace > 0 and us + dspace > sl:
+                return errno.EDQUOT
+            if il and dinodes > 0 and ui + dinodes > il:
+                return errno.EDQUOT
+        return 0
+
+    def _tree_usage(self, tx: KVTxn, ino: int) -> tuple[int, int]:
+        """(space, inodes) of a whole subtree including its root — what a
+        cross-quota-tree move must transfer (reference quota.go rename)."""
+        space = inodes = 0
+        stack = [ino]
+        while stack:  # iterative: arbitrarily deep trees must not blow the
+            cur = stack.pop()  # Python stack (cf. base.py remove_recursive)
+            attr = self._get_attr(tx, cur)
+            if attr is None:
+                continue
+            space += _direct_space(attr)
+            inodes += 1
+            if attr.typ == TYPE_DIRECTORY:
+                stack.extend(child for _n, _t, child in self._scan_entries(tx, cur))
+        return space, inodes
 
     def set_dir_quota(self, ctx: Context, ino: int, space_limit: int, inode_limit: int) -> int:
         """Set/replace a directory quota; current usage is initialized from
@@ -1111,7 +1258,7 @@ class KVMeta(BaseMeta):
             )
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def get_dir_quota(self, ino: int):
         raw = self.client.simple_txn(lambda tx: tx.get(self._dirquota_key(ino)))
@@ -1124,7 +1271,7 @@ class KVMeta(BaseMeta):
             tx.delete(self._dirquota_key(ino))
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def list_dir_quotas(self) -> dict[int, tuple[int, int, int, int]]:
         out = {}
@@ -1151,27 +1298,12 @@ class KVMeta(BaseMeta):
             if typ:
                 return errno.EEXIST, 0
 
-            # Pass 1: measure the subtree (inodes/space), so the quota
-            # check happens BEFORE any mutation — an errno return does not
-            # roll the txn back, so nothing may be written on failure.
-            count = [0]
-            space = [0]
-            length = [0]
-
-            def count_tree(ino: int) -> None:
-                attr = self._get_attr(tx, ino)
-                if attr is None:
-                    return
-                count[0] += 1
-                space[0] += _align4k(attr.length) + (
-                    4096 if attr.typ == TYPE_DIRECTORY else 0
-                )
-                length[0] += attr.length if attr.typ == TYPE_FILE else 0
-                if attr.typ == TYPE_DIRECTORY:
-                    for _n, _t, child in self._scan_entries(tx, ino):
-                        count_tree(child)
-
-            count_tree(src_ino)
+            # Pass 1: measure the subtree (inodes/space) for the capacity
+            # and quota checks (iterative walk — deep trees must not blow
+            # the Python stack).
+            tspace, tcount = self._tree_usage(tx, src_ino)
+            space = [tspace]
+            count = [tcount]
             if space[0] > 0 and self.fmt.capacity:
                 if self._counter_get(tx, "usedSpace") + space[0] > self.fmt.capacity:
                     return errno.ENOSPC, 0
@@ -1246,6 +1378,9 @@ class KVMeta(BaseMeta):
             # dst_parent's dirstat gains only its one new direct child
             if sattr.typ == TYPE_DIRECTORY:
                 self._update_dirstat(tx, dst_parent, 0, 4096, 1)
+                # the cloned subtree below the root is invisible to the
+                # dirstat delta; charge it to the ancestor quotas explicitly
+                self._quota_update(tx, dst_parent, space[0] - 4096, count[0] - 1)
             else:
                 self._update_dirstat(
                     tx, dst_parent, sattr.length, _align4k(sattr.length), 1
@@ -1277,7 +1412,7 @@ class KVMeta(BaseMeta):
             tx.set(key, value)
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def do_listxattr(self, ino) -> tuple[int, list[bytes]]:
         def fn(tx: KVTxn):
@@ -1296,7 +1431,7 @@ class KVMeta(BaseMeta):
             tx.delete(key)
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     # ---- locks (reference redis_lock.go / tkv_lock.go semantics) ---------
     F_UNLCK, F_RDLCK, F_WRLCK = 2, 0, 1
@@ -1327,7 +1462,7 @@ class KVMeta(BaseMeta):
                 tx.delete(key)
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def setlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int, pid: int = 0) -> int:
         """POSIX record lock set/unset; non-blocking (reference Setlk)."""
@@ -1367,7 +1502,7 @@ class KVMeta(BaseMeta):
                 tx.delete(key)
             return 0
 
-        return self.client.txn(fn)
+        return self._etxn(fn)
 
     def getlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int) -> tuple[int, int, int, int, int]:
         """Returns (errno, ltype, start, end, pid); F_UNLCK if free."""

@@ -28,6 +28,17 @@ logger = get_logger("meta.tkv")
 class KVTxn:
     """One transaction. Reads see the snapshot plus this txn's own writes."""
 
+    _discarded = False
+
+    def discard(self) -> None:
+        """Mark the transaction aborted: buffered writes must not commit.
+
+        Mirrors the reference's Go semantics (pkg/meta/tkv.go txn): a do_*
+        closure that returns a nonzero errno aborts the backend transaction,
+        so a mutate-then-fail path can never leak counters or partial state.
+        """
+        self._discarded = True
+
     def get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
 
@@ -86,6 +97,10 @@ class TKVClient:
     def simple_txn(self, fn: Callable[[KVTxn], object]) -> object:
         """Read-mostly transaction; same semantics, may skip write locking."""
         return self.txn(fn)
+
+    def in_txn(self) -> bool:
+        """True when the calling thread is inside an open transaction."""
+        return False
 
     def scan(self, begin: bytes, end: bytes) -> Iterator[tuple[bytes, bytes]]:
         """Non-transactional bulk scan for gc/fsck/dump sweeps."""
@@ -171,6 +186,9 @@ class MemKV(TKVClient):
         self._lock = threading.RLock()
         self._local = threading.local()
 
+    def in_txn(self) -> bool:
+        return getattr(self._local, "tx", None) is not None
+
     def txn(self, fn, retries: int = 50):
         # nested txn: join the enclosing transaction (single atomic commit)
         active = getattr(self._local, "tx", None)
@@ -183,6 +201,8 @@ class MemKV(TKVClient):
                 result = fn(tx)
             finally:
                 self._local.tx = None
+            if tx._discarded:
+                return result
             for k, v in tx._writes.items():
                 if v is None:
                     if k in self._data:
@@ -273,6 +293,9 @@ class SqliteKV(TKVClient):
             self._local.conn = conn
         return conn
 
+    def in_txn(self) -> bool:
+        return getattr(self._local, "in_txn", False)
+
     def txn(self, fn, retries: int = 50):
         conn = self._get_conn()
         # nested txn: join the enclosing transaction (single atomic commit)
@@ -284,8 +307,9 @@ class SqliteKV(TKVClient):
                 try:
                     conn.execute("BEGIN IMMEDIATE")
                     self._local.in_txn = True
-                    result = fn(_SqliteTxn(conn))
-                    conn.execute("COMMIT")
+                    tx = _SqliteTxn(conn)
+                    result = fn(tx)
+                    conn.execute("ROLLBACK" if tx._discarded else "COMMIT")
                     return result
                 except sqlite3.OperationalError as e:
                     try:

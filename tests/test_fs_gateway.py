@@ -203,6 +203,30 @@ def test_s3_path_escape_denied(s3):
     assert st in (403, 500)
 
 
+def test_s3_upload_id_traversal_denied(s3):
+    """A forged uploadId must never reach the multipart path join
+    (advisor: '../../<bucket>' abort deleted a non-empty bucket)."""
+    _req(s3, "PUT", "/b")
+    st, _, _ = _req(s3, "PUT", "/b/keep.txt", body=b"data",
+                    headers={"Content-Length": "4"})
+    assert st == 200
+    evil = urllib.parse.quote("../../b", safe="")
+    st, _, body = _req(s3, "DELETE", f"/b/mp.bin?uploadId={evil}")
+    assert st == 404 and b"NoSuchUpload" in body
+    # the bucket and its object survived
+    st, _, _ = _req(s3, "HEAD", "/b/keep.txt")
+    assert st == 200
+    # forged ids can't write outside the multipart area either
+    st, _, _ = _req(s3, "PUT", f"/b/mp.bin?partNumber=1&uploadId={evil}",
+                    body=b"x", headers={"Content-Length": "1"})
+    assert st == 404
+    # and complete with a forged id is rejected
+    st, _, _ = _req(s3, "POST", f"/b/mp.bin?uploadId={evil}",
+                    body=b"<CompleteMultipartUpload/>",
+                    headers={"Content-Length": "26"})
+    assert st == 404
+
+
 # --------------------------------------------------------------- WebDAV --
 
 @pytest.fixture
