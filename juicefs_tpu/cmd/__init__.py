@@ -83,6 +83,7 @@ def main(argv: list[str] | None = None) -> int:
         gateway,
         gc,
         info,
+        meta_server,
         mount,
         objbench,
         quota,
@@ -98,7 +99,7 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     for mod in (
         format_cmd, mount, bench, objbench, gc, fsck, sync, dump, warmup,
-        info, gateway, stats, quota,
+        info, gateway, stats, quota, meta_server,
     ):
         mod.add_parser(sub)
     args = parser.parse_args(argv)

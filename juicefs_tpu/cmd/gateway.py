@@ -19,6 +19,10 @@ def add_parser(sub):
     g.add_argument("--cache-dir", default="")
     g.add_argument("--cache-size", type=int, default=0)
     g.add_argument("--writeback", action="store_true")
+    g.add_argument("--access-key", default="", help="SigV4 access key "
+                   "(or MINIO_ROOT_USER); auth disabled when empty")
+    g.add_argument("--secret-key", default="", help="SigV4 secret key "
+                   "(or MINIO_ROOT_PASSWORD)")
     g.set_defaults(func=run_gateway)
 
     w = sub.add_parser("webdav", help="serve the volume over WebDAV")
@@ -59,10 +63,16 @@ def _serve_forever(vfs, m, server, what: str, port: int):
 
 
 def run_gateway(args) -> int:
+    import os
+
     from ..gateway import S3Gateway
 
     fs, vfs, m = _build_fs(args)
-    gw = S3Gateway(fs, args.address, args.port)
+    # credentials: flags, else the MinIO-convention env vars the reference
+    # gateway reads (cmd/gateway.go MINIO_ROOT_USER/PASSWORD)
+    ak = args.access_key or os.environ.get("MINIO_ROOT_USER", "")
+    sk = args.secret_key or os.environ.get("MINIO_ROOT_PASSWORD", "")
+    gw = S3Gateway(fs, args.address, args.port, access_key=ak, secret_key=sk)
     port = gw.start()
     return _serve_forever(vfs, m, gw, "S3 gateway", port)
 

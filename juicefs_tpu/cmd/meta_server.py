@@ -1,0 +1,29 @@
+"""`meta-server` — serve the bundled Redis-protocol meta transport.
+
+Runs the wire-compatible Redis-subset server (meta/redis_server.py) so
+multiple hosts can share one volume via `redis://host:port/db` meta URLs
+without an external Redis deployment (reference: the Redis/TiKV server
+the Go engines dial; pkg/meta/redis.go:54-76).
+"""
+
+from __future__ import annotations
+
+
+def add_parser(sub):
+    p = sub.add_parser(
+        "meta-server",
+        help="serve the bundled Redis-protocol metadata transport",
+    )
+    p.add_argument("--host", default="0.0.0.0", help="bind address")
+    p.add_argument("--port", type=int, default=6389, help="bind port")
+    p.set_defaults(func=run)
+
+
+def run(args) -> int:
+    from ..meta.redis_server import RedisServer
+
+    srv = RedisServer(args.host, args.port)
+    port = srv.start()
+    print(f"meta-server listening on {args.host}:{port}", flush=True)
+    srv.wait()
+    return 0

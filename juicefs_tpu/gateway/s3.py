@@ -10,8 +10,9 @@ Mirrors the reference's MinIO-based gateway semantics (pkg/gateway):
 Implements the subset real clients exercise: ListBuckets, Create/Delete
 bucket, HeadBucket, ListObjectsV2 (prefix + delimiter + continuation),
 Get/Put/Head/Delete/Copy object, and multipart Create/UploadPart/
-Complete/Abort. Auth is accepted but not verified (deploy behind a
-trusted boundary or a signing proxy).
+Complete/Abort. With access/secret keys configured every request is
+verified against AWS SigV4 (reference: MinIO auth layer); without them
+auth is accepted as-is (trusted boundary / signing proxy).
 """
 
 from __future__ import annotations
@@ -44,14 +45,87 @@ def _etag(data: bytes) -> str:
 class S3Gateway(HTTPAdapter):
     _name = "s3-gateway"
 
-    def __init__(self, fs: FileSystem, address: str = "127.0.0.1", port: int = 9000):
+    def __init__(
+        self,
+        fs: FileSystem,
+        address: str = "127.0.0.1",
+        port: int = 9000,
+        access_key: str = "",
+        secret_key: str = "",
+    ):
         super().__init__(address, port)
         self.fs = fs
+        if access_key:
+            from ..object.s3 import SigV4
+
+            self.signer = SigV4(access_key, secret_key)
+        else:
+            self.signer = None  # trusted-boundary mode: auth accepted as-is
         gw = self
 
         class Handler(BaseHandler):
             def log_message(self, fmt, *args):
                 logger.debug(fmt, *args)
+
+            def _body(self):
+                # handlers may run after _authorized already consumed the
+                # stream to hash it; serve the cached copy (cleared per
+                # request in _authorized)
+                cached = getattr(self, "_body_cache", None)
+                if cached is None:
+                    cached = BaseHandler._body(self)
+                    self._body_cache = cached
+                return cached
+
+            def _authorized(self) -> bool:
+                """Verify AWS SigV4 when the gateway has credentials
+                (reference: MinIO auth layer in pkg/gateway): signature,
+                payload hash, and a ±15 min date window (replay bound)."""
+                self._body_cache = None  # new request on this connection
+                if gw.signer is None:
+                    return True
+                import datetime as _dt
+                import hashlib as _hashlib
+
+                headers = {k.lower(): v for k, v in self.headers.items()}
+                amz_date = headers.get("x-amz-date", "")
+                try:
+                    ts = _dt.datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+                        tzinfo=_dt.timezone.utc
+                    )
+                except ValueError:
+                    self._body()
+                    self._error(403, "AccessDenied", "missing x-amz-date")
+                    return False
+                skew = abs((_dt.datetime.now(_dt.timezone.utc) - ts).total_seconds())
+                if skew > 900:
+                    self._body()
+                    self._error(403, "RequestTimeTooSkewed")
+                    return False
+                # the signed payload hash must match the actual body
+                body = self._body()
+                if headers.get("x-amz-content-sha256", "") != _hashlib.sha256(
+                    body
+                ).hexdigest():
+                    self._error(400, "XAmzContentSHA256Mismatch")
+                    return False
+                u = urllib.parse.urlsplit(self.path)
+                query = {
+                    k: v[0]
+                    for k, v in urllib.parse.parse_qs(
+                        u.query, keep_blank_values=True
+                    ).items()
+                }
+                ok = gw.signer.verify(
+                    self.command,
+                    urllib.parse.unquote(u.path),
+                    query,
+                    headers,
+                    self.headers.get("Authorization", ""),
+                )
+                if not ok:
+                    self._error(403, "SignatureDoesNotMatch")
+                return ok
 
             def _params(self):
                 u = urllib.parse.urlsplit(self.path)
@@ -75,6 +149,8 @@ class S3Gateway(HTTPAdapter):
 
             # -- dispatch --------------------------------------------------
             def do_GET(self):
+                if not self._authorized():
+                    return
                 bucket, key, q = self._params()
                 try:
                     if not bucket:
@@ -88,6 +164,8 @@ class S3Gateway(HTTPAdapter):
                     self._map_fs_error(e)
 
             def do_HEAD(self):
+                if not self._authorized():
+                    return
                 bucket, key, q = self._params()
                 try:
                     if bucket and not key:
@@ -98,6 +176,8 @@ class S3Gateway(HTTPAdapter):
                     self._empty(404 if e.errno == _errno.ENOENT else 500)
 
             def do_PUT(self):
+                if not self._authorized():
+                    return
                 bucket, key, q = self._params()
                 try:
                     if bucket and not key:
@@ -114,6 +194,8 @@ class S3Gateway(HTTPAdapter):
                     self._map_fs_error(e)
 
             def do_POST(self):
+                if not self._authorized():
+                    return
                 bucket, key, q = self._params()
                 try:
                     if "uploads" in q:
@@ -127,6 +209,8 @@ class S3Gateway(HTTPAdapter):
                     self._map_fs_error(e)
 
             def do_DELETE(self):
+                if not self._authorized():
+                    return
                 bucket, key, q = self._params()
                 try:
                     if "uploadId" in q:
@@ -294,7 +378,9 @@ class S3Gateway(HTTPAdapter):
         prefix = q.get("prefix", [""])[0]
         delimiter = q.get("delimiter", [""])[0]
         max_keys = int(q.get("max-keys", ["1000"])[0])
-        token = q.get("continuation-token", q.get("marker", [""]))[0]
+        token = q.get(
+            "continuation-token", q.get("start-after", q.get("marker", [""]))
+        )[0]
 
         keys: list[tuple[str, object]] = []
         self._walk(bucket, "", keys, prefix)

@@ -96,6 +96,7 @@ class KVMeta(BaseMeta):
         super().__init__(addr)
         self.client = client
         self._nlocal = threading.local()  # deferred notification buffer
+        self._qcache: tuple[set[int], float] | None = None  # quota-roots hint
 
     def name(self) -> str:
         return self.client.name
@@ -712,7 +713,9 @@ class KVMeta(BaseMeta):
             if psrc != pdst:
                 squota = self._quota_roots(tx, psrc)
                 dquota = self._quota_roots(tx, pdst)
-                if (squota or dquota) and not flags & RENAME_EXCHANGE:
+                if squota != dquota and not flags & RENAME_EXCHANGE:
+                    # identical chains see no net change: skip the subtree
+                    # walk and the no-op transfer entirely
                     if styp == TYPE_DIRECTORY:
                         move_space, move_inodes = self._tree_usage(tx, sino)
                     else:
@@ -725,7 +728,7 @@ class KVMeta(BaseMeta):
                     return errno.ENOENT, 0, Attr()
                 s_direct = _direct_space(sattr)
                 d_direct = _direct_space(dattr)
-                if psrc != pdst and (squota or dquota):
+                if psrc != pdst and squota != dquota:
                     s_space, s_inodes = (
                         self._tree_usage(tx, sino)
                         if styp == TYPE_DIRECTORY
@@ -766,7 +769,7 @@ class KVMeta(BaseMeta):
                     dsz = _direct_len(dattr)
                     self._update_dirstat(tx, psrc, dsz - ssz, d_direct - s_direct, 0)
                     self._update_dirstat(tx, pdst, ssz - dsz, s_direct - d_direct, 0)
-                    if squota or dquota:
+                    if squota != dquota:
                         # subtrees below the swapped roots are invisible to
                         # the dirstat delta; transfer them explicitly
                         extra_s = (d_space - d_direct) - (s_space - s_direct)
@@ -790,7 +793,7 @@ class KVMeta(BaseMeta):
                 st = self._free_entry(tx, pdst, ndst, dtyp, dino, dattr, now)
                 if st:
                     return st, 0, Attr()
-            if psrc != pdst and (squota or dquota):
+            if psrc != pdst and squota != dquota:
                 # checked AFTER _free_entry: a replaced destination already
                 # released its usage in this txn, so a net-zero replace
                 # never EDQUOTs (errno returns discard the txn)
@@ -822,7 +825,7 @@ class KVMeta(BaseMeta):
             dspace = _direct_space(sattr)
             self._update_dirstat(tx, psrc, -dsz, -dspace, -1)
             self._update_dirstat(tx, pdst, dsz, dspace, 1)
-            if styp == TYPE_DIRECTORY and psrc != pdst and (squota or dquota):
+            if styp == TYPE_DIRECTORY and psrc != pdst and squota != dquota:
                 # the subtree below the moved dir is invisible to the
                 # dirstat delta; transfer it between the quota chains
                 extra_s, extra_i = move_space - 4096, move_inodes - 1
@@ -1169,13 +1172,38 @@ class KVMeta(BaseMeta):
     # ---- dir quotas (reference pkg/meta/quota.go:32-44,209,396) ----------
     _QFMT = struct.Struct(">qqqq")  # space_limit inode_limit used_space used_inodes
 
+    _QUOTA_HINT_TTL = 1.0
+
+    def _quota_roots_hint(self) -> set[int]:
+        """Cached set of quota-root inodes (reference quota.go keeps loaded
+        quotas in memory, refreshed periodically). The hint only prunes the
+        ancestor walk — actual records are still read inside the txn — so
+        the ONLY staleness effect is a new quota taking up to TTL seconds
+        to be seen by other clients, same as the reference's flush cadence.
+        Without it every dirstat update walks the parent chain: O(depth)
+        network round trips per op on a networked engine."""
+        cached = self._qcache
+        now = time.monotonic()
+        if cached is not None and now - cached[1] <= self._QUOTA_HINT_TTL:
+            return cached[0]
+        roots: set[int] = set()
+        for k, _ in self.client.scan(b"QD", next_key(b"QD")):
+            if len(k) == 10:
+                roots.add(int.from_bytes(k[2:], "big"))
+        self._qcache = (roots, now)
+        return roots
+
     def _quota_chain(self, tx: KVTxn, dir_ino: int):
         """Yield (ino, record) for every quota on the ancestor chain."""
+        hint = self._quota_roots_hint()
+        if not hint:
+            return
         ino, hops = dir_ino, 0
         while ino and hops < 100:
-            raw = tx.get(self._dirquota_key(ino))
-            if raw:
-                yield ino, raw
+            if ino in hint:
+                raw = tx.get(self._dirquota_key(ino))
+                if raw:
+                    yield ino, raw
             if ino == ROOT_INODE:
                 break
             attr = self._get_attr(tx, ino)
@@ -1258,7 +1286,9 @@ class KVMeta(BaseMeta):
             )
             return 0
 
-        return self._etxn(fn)
+        st = self._etxn(fn)
+        self._qcache = None
+        return st
 
     def get_dir_quota(self, ino: int):
         raw = self.client.simple_txn(lambda tx: tx.get(self._dirquota_key(ino)))
@@ -1271,7 +1301,9 @@ class KVMeta(BaseMeta):
             tx.delete(self._dirquota_key(ino))
             return 0
 
-        return self._etxn(fn)
+        st = self._etxn(fn)
+        self._qcache = None
+        return st
 
     def list_dir_quotas(self) -> dict[int, tuple[int, int, int, int]]:
         out = {}
@@ -1299,38 +1331,45 @@ class KVMeta(BaseMeta):
                 return errno.EEXIST, 0
 
             # Pass 1: measure the subtree (inodes/space) for the capacity
-            # and quota checks (iterative walk — deep trees must not blow
-            # the Python stack).
-            tspace, tcount = self._tree_usage(tx, src_ino)
-            space = [tspace]
-            count = [tcount]
-            if space[0] > 0 and self.fmt.capacity:
-                if self._counter_get(tx, "usedSpace") + space[0] > self.fmt.capacity:
+            # and quota checks.
+            space, count = self._tree_usage(tx, src_ino)
+            if space > 0 and self.fmt.capacity:
+                if self._counter_get(tx, "usedSpace") + space > self.fmt.capacity:
                     return errno.ENOSPC, 0
             if self.fmt.inodes:
-                if self._counter_get(tx, "totalInodes") + count[0] > self.fmt.inodes:
+                if self._counter_get(tx, "totalInodes") + count > self.fmt.inodes:
                     return errno.ENOSPC, 0
-            st = self._quota_check(tx, dst_parent, space[0], count[0])
+            st = self._quota_check(tx, dst_parent, space, count)
             if st:
                 return st, 0
-            base = tx.incr_by(self._counter_key("nextInode"), count[0]) - count[0]
-            next_ino = [base]
+            next_ino = tx.incr_by(self._counter_key("nextInode"), count) - count
             now = time.time()
 
-            def copy_tree(old: int, new_parent: int) -> int:
+            # Pass 2: iterative pre-order copy (deep trees must not blow
+            # the Python stack); children link into their parent as they
+            # are visited, dir nlinks are patched once at the end.
+            new_root = 0
+            dir_attrs: dict[int, Attr] = {}  # new dir ino -> its attr
+            dir_children: dict[int, int] = {}  # new dir ino -> dir child count
+            stack = [(src_ino, dst_parent, None, 0)]
+            while stack:
+                old, new_parent, cname, ctyp = stack.pop()
                 attr = self._get_attr(tx, old)
                 if attr is None:
-                    return 0  # dangling entry: skip, like count_tree
-                new = next_ino[0]
-                next_ino[0] += 1
+                    continue  # dangling entry: skip, like the measurement
+                new = next_ino
+                next_ino += 1
                 nattr = Attr.decode(attr.encode())  # deep copy via codec
                 nattr.parent = new_parent
                 nattr.touch_ctime(now)
-                if nattr.typ == TYPE_DIRECTORY:
-                    nattr.nlink = 2
-                else:
-                    nattr.nlink = 1
+                nattr.nlink = 2 if nattr.typ == TYPE_DIRECTORY else 1
                 self._set_attr(tx, new, nattr)
+                if cname is None:
+                    new_root = new
+                else:
+                    self._set_entry(tx, new_parent, cname, ctyp, new)
+                    if ctyp == TYPE_DIRECTORY:
+                        dir_children[new_parent] = dir_children.get(new_parent, 0) + 1
                 # xattrs
                 xprefix = self._ino_key(old) + b"X"
                 for k, v in tx.scan(xprefix, next_key(xprefix)):
@@ -1347,40 +1386,32 @@ class KVMeta(BaseMeta):
                         for s in Slice.decode_list(v):
                             if s.id:
                                 self._incref_slice(tx, s.id, s.size)
-                else:  # directory: recurse
-                    nchildren = 0
-                    for cname, ctyp, child in self._scan_entries(tx, old):
-                        cnew = copy_tree(child, new)
-                        if cnew == 0:
-                            continue  # dangling child skipped
-                        self._set_entry(tx, new, cname, ctyp, cnew)
-                        if ctyp == TYPE_DIRECTORY:
-                            nchildren += 1
-                    if nchildren:
-                        nattr.nlink = 2 + nchildren
-                        self._set_attr(tx, new, nattr)
-                    # dirstats are per-directory direct children: the source
-                    # dir's stats apply verbatim to its clone
+                else:  # directory: queue children, copy dirstat verbatim
+                    dir_attrs[new] = nattr
+                    for name2, typ2, child in self._scan_entries(tx, old):
+                        stack.append((child, new, name2, typ2))
                     dstat = tx.get(self._dirstat_key(old))
                     if dstat is not None:
                         tx.set(self._dirstat_key(new), dstat)
-                return new
-
-            new_root = copy_tree(src_ino, dst_parent)
+            for dino, n in dir_children.items():
+                nattr = dir_attrs.get(dino)
+                if nattr is not None and n:
+                    nattr.nlink = 2 + n
+                    self._set_attr(tx, dino, nattr)
             self._set_entry(tx, dst_parent, name, sattr.typ, new_root)
             if sattr.typ == TYPE_DIRECTORY:
                 pattr.nlink += 1
             pattr.touch_mtime(now)
             self._set_attr(tx, dst_parent, pattr)
             # quota checked above; only charge the counters here
-            tx.incr_by(self._counter_key("usedSpace"), space[0])
-            tx.incr_by(self._counter_key("totalInodes"), count[0])
+            tx.incr_by(self._counter_key("usedSpace"), space)
+            tx.incr_by(self._counter_key("totalInodes"), count)
             # dst_parent's dirstat gains only its one new direct child
             if sattr.typ == TYPE_DIRECTORY:
                 self._update_dirstat(tx, dst_parent, 0, 4096, 1)
                 # the cloned subtree below the root is invisible to the
                 # dirstat delta; charge it to the ancestor quotas explicitly
-                self._quota_update(tx, dst_parent, space[0] - 4096, count[0] - 1)
+                self._quota_update(tx, dst_parent, space - 4096, count - 1)
             else:
                 self._update_dirstat(
                     tx, dst_parent, sattr.length, _align4k(sattr.length), 1
@@ -1570,3 +1601,4 @@ interface.register("memkv", _factory)
 interface.register("mem", _factory)
 interface.register("sqlite3", _factory)
 interface.register("sqlite", _factory)
+interface.register("redis", _factory)

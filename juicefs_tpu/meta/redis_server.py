@@ -1,0 +1,366 @@
+"""Bundled Redis-protocol (RESP2) server — the networked meta transport.
+
+The reference's distribution story is many clients coordinating through a
+shared network DB (pkg/meta/redis.go, tkv.go over TiKV/etcd). This module
+provides that transport without external dependencies: a TCP server
+speaking the Redis wire protocol with exactly the command subset the
+RedisKV engine needs — strings, a lexicographic index (zset subset),
+and optimistic WATCH/MULTI/EXEC transactions with per-key versioning.
+
+It is wire-compatible with real Redis for these commands, so production
+deployments can point meta at an actual Redis/KeyDB cluster while tests
+and single-host setups use this in-process server (`juicefs-tpu
+meta-server` serves it standalone for true multi-host volumes).
+
+Concurrency model: thread per connection; one process-wide lock around
+command execution (Redis itself is single-threaded for commands); WATCH
+records per-key versions, EXEC validates them under the lock — the same
+optimistic scheme as Redis WATCH (redis.io/topics/transactions).
+"""
+
+from __future__ import annotations
+
+import bisect
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from ..utils import get_logger
+
+logger = get_logger("meta.redis_server")
+
+
+class _DB:
+    def __init__(self):
+        self.data: dict[bytes, bytes] = {}
+        self.versions: dict[bytes, int] = {}
+        self.zsets: dict[bytes, list[bytes]] = {}  # name -> sorted members
+
+    def bump(self, key: bytes) -> None:
+        self.versions[key] = self.versions.get(key, 0) + 1
+
+
+class RedisServer:
+    """Minimal RESP2 server. start() returns the bound port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, n_dbs: int = 16):
+        self.host, self.port = host, port
+        self.dbs = [_DB() for _ in range(n_dbs)]
+        self.lock = threading.RLock()
+        self._srv: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> int:
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                _Conn(outer, self.request).serve()
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Srv((self.host, self.port), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="redis-server", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+    def wait(self) -> None:
+        """Block until the server stops (or interrupt → stop)."""
+        try:
+            if self._thread is not None:
+                self._thread.join()
+        except KeyboardInterrupt:
+            self.stop()
+
+    def serve_forever(self) -> None:
+        """Blocking standalone serve (CLI `meta-server`)."""
+        self.start()
+        self.wait()
+
+
+class _Conn:
+    """One client connection: RESP parsing + command dispatch."""
+
+    def __init__(self, server: RedisServer, sock: socket.socket):
+        self.server = server
+        self.sock = sock
+        # without NODELAY every pipelined reply pair costs a ~40ms
+        # Nagle/delayed-ACK stall
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.rfile = sock.makefile("rb")
+        self.db = server.dbs[0]
+        self.watched: dict[bytes, int] = {}
+        self.in_multi = False
+        self.queue: list[list[bytes]] = []
+        self.multi_err = False
+
+    # ---- RESP ------------------------------------------------------------
+    def _read_cmd(self) -> Optional[list[bytes]]:
+        line = self.rfile.readline()
+        if not line:
+            return None
+        if not line.startswith(b"*"):
+            # inline command (telnet-style); not used by our client
+            return line.strip().split()
+        n = int(line[1:])
+        parts = []
+        for _ in range(n):
+            hdr = self.rfile.readline()
+            if not hdr.startswith(b"$"):
+                raise ValueError("protocol error")
+            ln = int(hdr[1:])
+            data = self.rfile.read(ln + 2)[:-2]
+            parts.append(data)
+        return parts
+
+    def _send(self, payload: bytes) -> None:
+        self.sock.sendall(payload)
+
+    @staticmethod
+    def _enc(obj) -> bytes:
+        if obj is None:
+            return b"$-1\r\n"
+        if isinstance(obj, _Err):
+            return b"-" + obj.msg.encode() + b"\r\n"
+        if isinstance(obj, _Status):
+            return b"+" + obj.msg.encode() + b"\r\n"
+        if isinstance(obj, int):
+            return b":" + str(obj).encode() + b"\r\n"
+        if isinstance(obj, bytes):
+            return b"$" + str(len(obj)).encode() + b"\r\n" + obj + b"\r\n"
+        if isinstance(obj, (list, tuple)):
+            if obj is NIL_ARRAY:
+                return b"*-1\r\n"
+            return b"*" + str(len(obj)).encode() + b"\r\n" + b"".join(
+                _Conn._enc(o) for o in obj
+            )
+        raise TypeError(f"cannot encode {type(obj)}")
+
+    # ---- serve loop ------------------------------------------------------
+    def serve(self) -> None:
+        try:
+            while True:
+                cmd = self._read_cmd()
+                if cmd is None or not cmd:
+                    return
+                name = cmd[0].upper()
+                if name == b"QUIT":
+                    self._send(b"+OK\r\n")
+                    return
+                out = self.dispatch(name, cmd[1:])
+                self._send(self._enc(out))
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def dispatch(self, name: bytes, args: list[bytes]):
+        if self.in_multi and name not in (b"EXEC", b"DISCARD", b"MULTI", b"WATCH"):
+            self.queue.append([name] + args)
+            return _Status("QUEUED")
+        handler = getattr(self, "cmd_" + name.decode().lower(), None)
+        if handler is None:
+            return _Err(f"ERR unknown command '{name.decode()}'")
+        with self.server.lock:
+            return handler(args)
+
+    # ---- commands --------------------------------------------------------
+    def cmd_ping(self, args):
+        return _Status("PONG") if not args else args[0]
+
+    def cmd_echo(self, args):
+        return args[0]
+
+    def cmd_select(self, args):
+        idx = int(args[0])
+        if not 0 <= idx < len(self.server.dbs):
+            return _Err("ERR DB index is out of range")
+        self.db = self.server.dbs[idx]
+        return _Status("OK")
+
+    def cmd_flushdb(self, args):
+        self.db.data.clear()
+        self.db.zsets.clear()
+        # bump everything watched so concurrent txns abort
+        for k in list(self.db.versions):
+            self.db.bump(k)
+        return _Status("OK")
+
+    def cmd_dbsize(self, args):
+        return len(self.db.data)
+
+    def cmd_get(self, args):
+        return self.db.data.get(args[0])
+
+    def cmd_mget(self, args):
+        return [self.db.data.get(k) for k in args]
+
+    def cmd_set(self, args):
+        self.db.data[args[0]] = args[1]
+        self.db.bump(args[0])
+        return _Status("OK")
+
+    def cmd_del(self, args):
+        n = 0
+        for k in args:
+            if k in self.db.data:
+                del self.db.data[k]
+                n += 1
+            self.db.bump(k)
+        return n
+
+    def cmd_exists(self, args):
+        return sum(1 for k in args if k in self.db.data)
+
+    def cmd_incrby(self, args):
+        cur = int(self.db.data.get(args[0], b"0"))
+        cur += int(args[1])
+        self.db.data[args[0]] = str(cur).encode()
+        self.db.bump(args[0])
+        return cur
+
+    def cmd_zadd(self, args):
+        # subset: ZADD key 0 member [0 member ...]
+        zs = self.db.zsets.setdefault(args[0], [])
+        added = 0
+        for i in range(1, len(args), 2):
+            member = args[i + 1]
+            j = bisect.bisect_left(zs, member)
+            if j >= len(zs) or zs[j] != member:
+                zs.insert(j, member)
+                added += 1
+        self.db.bump(args[0])
+        return added
+
+    def cmd_zrem(self, args):
+        zs = self.db.zsets.get(args[0], [])
+        removed = 0
+        for member in args[1:]:
+            j = bisect.bisect_left(zs, member)
+            if j < len(zs) and zs[j] == member:
+                zs.pop(j)
+                removed += 1
+        self.db.bump(args[0])
+        return removed
+
+    def cmd_zcard(self, args):
+        return len(self.db.zsets.get(args[0], []))
+
+    def cmd_zrangebylex(self, args):
+        zs = self.db.zsets.get(args[0], [])
+        lo = self._lex_bound(args[1], zs, True)
+        hi = self._lex_bound(args[2], zs, False)
+        out = zs[lo:hi]
+        if len(args) >= 6 and args[3].upper() == b"LIMIT":
+            off, cnt = int(args[4]), int(args[5])
+            out = out[off:] if cnt < 0 else out[off:off + cnt]
+        return list(out)
+
+    @staticmethod
+    def _lex_bound(spec: bytes, zs: list[bytes], is_min: bool) -> int:
+        if spec == b"-":
+            return 0
+        if spec == b"+":
+            return len(zs)
+        if spec.startswith(b"["):
+            v = spec[1:]
+            return bisect.bisect_left(zs, v) if is_min else bisect.bisect_right(zs, v)
+        if spec.startswith(b"("):
+            v = spec[1:]
+            return bisect.bisect_right(zs, v) if is_min else bisect.bisect_left(zs, v)
+        raise ValueError("bad lex range")
+
+    # ---- transactions ----------------------------------------------------
+    def cmd_watch(self, args):
+        if self.in_multi:
+            return _Err("ERR WATCH inside MULTI is not allowed")
+        for k in args:
+            self.watched[k] = self.db.versions.get(k, 0)
+        return _Status("OK")
+
+    def cmd_unwatch(self, args):
+        self.watched.clear()
+        return _Status("OK")
+
+    def cmd_multi(self, args):
+        if self.in_multi:
+            return _Err("ERR MULTI calls can not be nested")
+        self.in_multi = True
+        self.queue = []
+        return _Status("OK")
+
+    def cmd_discard(self, args):
+        self.in_multi = False
+        self.queue = []
+        self.watched.clear()
+        return _Status("OK")
+
+    def cmd_exec(self, args):
+        if not self.in_multi:
+            return _Err("ERR EXEC without MULTI")
+        self.in_multi = False
+        queue, self.queue = self.queue, []
+        with self.server.lock:
+            for k, ver in self.watched.items():
+                if self.db.versions.get(k, 0) != ver:
+                    self.watched.clear()
+                    return NIL_ARRAY  # conflict: txn aborted
+            self.watched.clear()
+            out = []
+            for q in queue:
+                handler = getattr(self, "cmd_" + q[0].decode().lower(), None)
+                out.append(
+                    handler(q[1:]) if handler else _Err("ERR unknown command")
+                )
+            return out
+
+
+class _Status:
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+
+class _Err(_Status):
+    pass
+
+
+NIL_ARRAY: list = []
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="meta-server",
+        description="serve the bundled Redis-protocol meta transport",
+    )
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=6389)
+    a = ap.parse_args(argv)
+    srv = RedisServer(a.host, a.port)
+    port = srv.start()
+    print(f"meta-server listening on {a.host}:{port}")
+    srv.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -356,4 +356,8 @@ def new_tkv_client(driver: str, addr: str) -> TKVClient:
         if addr and addr != ":memory:":
             os.makedirs(os.path.dirname(os.path.abspath(addr)) or ".", exist_ok=True)
         return SqliteKV(addr or ":memory:")
+    if driver == "redis":
+        from .redis_kv import RedisKV
+
+        return RedisKV(addr)
     raise ValueError(f"unknown tkv driver: {driver}")

@@ -44,8 +44,16 @@ def create_storage(uri: str) -> ObjectStorage:
     return _registry[scheme](addr)
 
 
+def _s3_factory(addr: str) -> ObjectStorage:
+    from .s3 import S3Storage
+
+    return S3Storage(addr)
+
+
 register("file", lambda addr: FileStorage(addr))
 register("mem", lambda addr: MemStorage(addr))
+register("s3", _s3_factory)
+register("minio", _s3_factory)
 
 __all__ = [
     "Obj",

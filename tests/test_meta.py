@@ -35,13 +35,28 @@ CTX = Context(uid=0, gid=0)
 USER = Context(uid=1000, gid=1000, gids=(1000,))
 
 
-@pytest.fixture(params=["memkv", "sqlite3"])
+@pytest.fixture(scope="session")
+def redis_server():
+    from juicefs_tpu.meta.redis_server import RedisServer
+
+    srv = RedisServer()
+    port = srv.start()
+    yield f"127.0.0.1:{port}"
+    srv.stop()
+
+
+@pytest.fixture(params=["memkv", "sqlite3", "redis"])
 def m(request, tmp_path):
     if request.param == "memkv":
         uri = "memkv://test"
+    elif request.param == "redis":
+        addr = request.getfixturevalue("redis_server")
+        uri = f"redis://{addr}/0"
     else:
         uri = f"sqlite3://{tmp_path}/meta.db"
     client = new_client(uri)
+    if request.param == "redis":
+        client.reset()  # the server is session-scoped: wipe previous state
     client.init(Format(name="test", trash_days=0), force=True)
     client.load()
     client.new_session()

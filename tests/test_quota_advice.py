@@ -186,6 +186,25 @@ def test_deep_tree_rename_no_recursion(m):
     assert _quota_used(m, qb)[1] == 1501
 
 
+def test_deep_tree_clone_no_recursion(m):
+    """clone of a deep dir chain must not hit the recursion limit."""
+    st, top, _ = m.mkdir(CTX, ROOT_INODE, b"deep", 0o755)
+    parent = top
+    for _ in range(1500):
+        st, parent, _ = m.mkdir(CTX, parent, b"d", 0o755)
+        assert st == 0
+    _write_file(m, parent, b"leaf", 4096)
+    st, new_root = m.clone(CTX, top, ROOT_INODE, b"deepcopy")
+    assert st == 0 and new_root
+    # the deepest file made it across
+    cur = new_root
+    for _ in range(1500):
+        st, cur, _ = m.lookup(CTX, cur, b"d")
+        assert st == 0
+    st, leaf, attr = m.lookup(CTX, cur, b"leaf")
+    assert st == 0 and attr.length == 4096
+
+
 def test_replace_rename_net_zero_no_edquot(m):
     """atomic-replace (write temp, rename over) must not EDQUOT when the
     net usage change is zero (review finding)."""
