@@ -145,7 +145,7 @@ def main() -> int:
 
         @jax.jit
         def step(words, counts, lengths):
-            d = _hj._hash_packed_pallas_impl(words, counts, lengths, interpret=False)
+            d = _hj.hash_packed_pallas(words, counts, lengths, interpret=False)
             dup, first = dedup_scan_jax(d)
             return d, dup, first
     else:

@@ -34,11 +34,23 @@ IDX_KEY = b"!idx"
 SCAN_PAGE = 2048
 
 
+class MetaNetworkError(ConnectionError):
+    """Socket-level failure talking to the meta server.
+
+    Distinct from the OSError-with-errno values the meta layer raises for
+    POSIX results (ENOENT, EEXIST, ...) so reconnect logic can never swallow
+    a real file-system errno (ADVICE r2 medium, redis_kv reconnect).
+    """
+
+
 class RespConnection:
     """One RESP2 connection (binary-safe, minimal)."""
 
     def __init__(self, host: str, port: int, db: int = 0, timeout: float = 30.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+        try:
+            self.sock = socket.create_connection((host, port), timeout=timeout)
+        except OSError as e:
+            raise MetaNetworkError(f"meta server connect failed: {e}") from e
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rfile = self.sock.makefile("rb")
         if db:
@@ -61,12 +73,18 @@ class RespConnection:
                 elif isinstance(arg, int):
                     arg = str(arg).encode()
                 buf += b"$" + str(len(arg)).encode() + b"\r\n" + arg + b"\r\n"
-        self.sock.sendall(bytes(buf))
+        try:
+            self.sock.sendall(bytes(buf))
+        except OSError as e:
+            raise MetaNetworkError(f"meta server send failed: {e}") from e
 
     def read_reply(self):
-        line = self.rfile.readline()
+        try:
+            line = self.rfile.readline()
+        except OSError as e:
+            raise MetaNetworkError(f"meta server read failed: {e}") from e
         if not line:
-            raise ConnectionError("meta server closed connection")
+            raise MetaNetworkError("meta server closed connection")
         t, rest = line[:1], line[1:-2]
         if t == b"+":
             return rest.decode()
@@ -78,7 +96,13 @@ class RespConnection:
             n = int(rest)
             if n < 0:
                 return None
-            return self.rfile.read(n + 2)[:-2]
+            try:
+                data = self.rfile.read(n + 2)
+            except OSError as e:
+                raise MetaNetworkError(f"meta server read failed: {e}") from e
+            if len(data) != n + 2:
+                raise MetaNetworkError("meta server closed mid bulk reply")
+            return data[:-2]
         if t == b"*":
             n = int(rest)
             if n < 0:
@@ -178,8 +202,36 @@ class RedisKV(TKVClient):
             self._local.conn = conn
         return conn
 
+    def _drop_conn(self) -> None:
+        """Discard this thread's connection so the next use redials.
+
+        Without this a single socket error poisoned the thread-local
+        connection forever (ADVICE r2 medium): every later meta op on the
+        thread failed on the same dead socket.
+        """
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    # Commands execute() may transparently re-send after a network error:
+    # re-running any of these converges to the same end state. Anything not
+    # listed (a hypothetical INCR/APPEND) fails fast instead, because the
+    # server may already have applied it before the reply was lost.
+    _IDEMPOTENT = frozenset({
+        b"GET", b"MGET", b"EXISTS", b"PING", b"SELECT", b"ZRANGEBYLEX",
+        b"SET", b"DEL", b"ZREM", b"ZADD", b"UNWATCH", b"FLUSHDB",
+    })
+
     def execute(self, *args):
-        return self._conn().execute(*args)
+        cmd = args[0] if isinstance(args[0], bytes) else str(args[0]).encode()
+        if cmd.upper() in self._IDEMPOTENT:
+            return self._retry_io(lambda: self._conn().execute(*args))
+        try:
+            return self._conn().execute(*args)
+        except MetaNetworkError:
+            self._drop_conn()
+            raise
 
     def in_txn(self) -> bool:
         return getattr(self._local, "tx", None) is not None
@@ -199,61 +251,106 @@ class RedisKV(TKVClient):
             lo = b"(" + page[-1]
 
     # -- transactions ------------------------------------------------------
+    def _unwatch_quiet(self, conn: RespConnection) -> None:
+        """Best-effort UNWATCH that can never mask the primary exception."""
+        try:
+            conn.execute(b"UNWATCH")
+        except Exception:
+            self._drop_conn()  # dead socket: uncache so next use redials
+
     def txn(self, fn, retries: int = 50):
         active = getattr(self._local, "tx", None)
         if active is not None:
             return fn(active)  # nested: join (single atomic commit)
-        conn = self._conn()
         last: Exception | None = None
         for attempt in range(retries):
-            tx = _RedisTxn(self, conn)
-            self._local.tx = tx
+            committing = False
             try:
-                result = fn(tx)
-            except BaseException:
-                conn.execute(b"UNWATCH")
-                raise
-            finally:
-                self._local.tx = None
-            if tx._discarded or not tx._writes:
-                conn.execute(b"UNWATCH")
-                return result
-            cmds: list[tuple] = [(b"MULTI",)]
-            adds = [k for k, v in tx._writes.items() if v is not None]
-            dels = [k for k, v in tx._writes.items() if v is None]
-            for k in adds:
-                cmds.append((b"SET", k, tx._writes[k]))
-            if dels:
-                cmds.append(tuple([b"DEL"] + dels))
-                cmds.append(tuple([b"ZREM", IDX_KEY] + dels))
-            if adds:
-                zadd: list = [b"ZADD", IDX_KEY]
+                conn = self._conn()
+                tx = _RedisTxn(self, conn)
+                self._local.tx = tx
+                try:
+                    result = fn(tx)
+                except BaseException:
+                    self._unwatch_quiet(conn)
+                    raise
+                finally:
+                    self._local.tx = None
+                if tx._discarded or not tx._writes:
+                    self._unwatch_quiet(conn)
+                    return result
+                cmds: list[tuple] = [(b"MULTI",)]
+                adds = [k for k, v in tx._writes.items() if v is not None]
+                dels = [k for k, v in tx._writes.items() if v is None]
                 for k in adds:
-                    zadd += [b"0", k]
-                cmds.append(tuple(zadd))
-            cmds.append((b"EXEC",))
-            conn.send(*cmds)
-            replies = [conn.read_reply() for _ in cmds]
-            if replies[-1] is not None:
-                return result  # committed
-            last = ConflictError(f"txn conflict (attempt {attempt})")
+                    cmds.append((b"SET", k, tx._writes[k]))
+                if dels:
+                    cmds.append(tuple([b"DEL"] + dels))
+                    cmds.append(tuple([b"ZREM", IDX_KEY] + dels))
+                if adds:
+                    zadd: list = [b"ZADD", IDX_KEY]
+                    for k in adds:
+                        zadd += [b"0", k]
+                    cmds.append(tuple(zadd))
+                cmds.append((b"EXEC",))
+                conn.send(*cmds)
+                # send() raising means EXEC (the pipeline tail) never fully
+                # reached the server, so that is still a safe retry; only
+                # after a complete send is the commit outcome ambiguous.
+                committing = True
+                replies = [conn.read_reply() for _ in cmds]
+                if replies[-1] is not None:
+                    return result  # committed
+                last = ConflictError(f"txn conflict (attempt {attempt})")
+            except MetaNetworkError as e:
+                # Connection died mid-attempt: redial (ADVICE r2 medium).
+                # Before the commit pipeline goes out nothing can have been
+                # applied (reads only WATCH), so the closure retries safely.
+                # Once EXEC may have reached the server the outcome is
+                # unknowable — a blind retry could double-apply a
+                # read-modify-write — so surface the error to the caller.
+                self._drop_conn()
+                if committing:
+                    raise MetaNetworkError(
+                        "connection lost while committing; outcome unknown"
+                    ) from e
+                last = e
+            except RedisError:
+                # Server-side command error mid-pipeline: later replies are
+                # unread, so the connection is desynced — drop it.
+                self._drop_conn()
+                raise
             time.sleep(min(0.0005 * (1 << min(attempt, 8)), 0.05))
         raise last  # type: ignore[misc]
 
     # -- non-txn bulk scan (gc/fsck/dump sweeps) ---------------------------
+    def _retry_io(self, op):
+        """Run op(); on a network error redial once and rerun (reads only)."""
+        try:
+            return op()
+        except MetaNetworkError:
+            self._drop_conn()
+            if self.in_txn():
+                raise
+            return op()
+
     def scan(self, begin, end) -> Iterator[tuple[bytes, bytes]]:
-        conn = self._conn()
-        names = self._range(conn, begin, end)
+        names = self._retry_io(lambda: self._range(self._conn(), begin, end))
+
+        def mget(chunk):
+            conn = self._conn()
+            conn.send([b"MGET"] + chunk)
+            return conn.read_reply()
+
         for i in range(0, len(names), SCAN_PAGE):
             chunk = names[i:i + SCAN_PAGE]
-            conn.send([b"MGET"] + chunk)
-            vals = conn.read_reply()
+            vals = self._retry_io(lambda: mget(chunk))
             for k, v in zip(chunk, vals):
                 if v is not None:
                     yield (k, v)
 
     def reset(self) -> None:
-        self._conn().execute(b"FLUSHDB")
+        self.execute(b"FLUSHDB")
 
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)
