@@ -1,0 +1,159 @@
+"""Write-path content indexer (the TPU fingerprint plane).
+
+The reference has no content addressing — block object keys are slice-id
+based and its gc diffs names only (SURVEY.md §2.2 hashing note,
+reference cmd/gc.go:253-296). This module is the north-star capability
+layered behind the same upload seam the reference compresses in
+(pkg/chunk/cached_store.go:371-413): every uploaded block is fingerprinted
+with JTH-256 *off* the write path and persisted in the meta engine under
+`B{sliceid}{indx} -> bsize+digest`, so `gc --dedup` and `fsck` consume an
+O(blocks) index instead of re-reading and re-hashing the whole volume.
+
+Design for the TPU: hashing wants large batches (the pipeline packs 32
+blocks = 128 MiB per dispatch), while uploads complete one block at a
+time, so the indexer decouples them with a bounded queue and a single
+background worker that batches, hashes (cpu/xla/pallas via HashPipeline),
+and writes digests to meta in batched transactions. The queue bound gives
+backpressure: if hashing falls behind, upload workers block in submit()
+instead of buffering unbounded raw bytes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+from ..utils import get_logger
+from .cached_store import parse_block_key
+
+logger = get_logger("chunk.indexer")
+
+_STOP = object()
+
+
+def pipeline_backend(hash_backend: str) -> str:
+    """Map a Format.hash_backend value to a HashPipeline backend."""
+    return {"tpu": "xla", "": "cpu"}.get(hash_backend, hash_backend)
+
+
+class BlockIndexer:
+    """Async batched block fingerprinting + persistent content index.
+
+    meta=None keeps digests in memory only (objbench measurement mode).
+    """
+
+    def __init__(
+        self,
+        meta=None,
+        backend: str = "cpu",
+        block_size: int = 4 << 20,
+        batch_blocks: int = 32,
+        queue_blocks: int = 64,
+    ):
+        from ..tpu.pipeline import HashPipeline, PipelineConfig
+
+        self.meta = meta
+        self.backend = backend
+        self._pipe = HashPipeline(
+            PipelineConfig(
+                backend=backend,
+                batch_blocks=batch_blocks,
+                pad_lanes=max(1, block_size // 65536),
+            )
+        )
+        self._batch_blocks = batch_blocks
+        self._q: queue.Queue = queue.Queue(maxsize=queue_blocks)
+        self._cond = threading.Condition()
+        self._pending = 0
+        # stats (read by objbench / stats cmd)
+        self.blocks = 0
+        self.bytes = 0
+        self.busy_seconds = 0.0
+        self.errors = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="block-indexer", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer side (upload pool threads) -------------------------------
+    def submit(self, key: str, raw: bytes) -> None:
+        """ChunkConfig.fingerprint hook: called per uploaded block."""
+        parsed = parse_block_key(key)
+        if parsed is None:
+            return
+        sid, indx, _bsize = parsed
+        self.submit_raw(sid, indx, len(raw), bytes(raw))
+
+    def submit_raw(self, sid: int, indx: int, bsize: int, raw: bytes) -> None:
+        with self._cond:
+            self._pending += 1
+        self._q.put((sid, indx, bsize, raw))
+
+    # -- worker ------------------------------------------------------------
+    def _loop(self) -> None:
+        batch: list = []
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                item = None
+            if item is _STOP:
+                self._process(batch)
+                return
+            if item is not None:
+                batch.append(item)
+            if batch and (len(batch) >= self._batch_blocks or item is None):
+                self._process(batch)
+                batch = []
+
+    def _process(self, batch: list) -> None:
+        if not batch:
+            return
+        t0 = time.perf_counter()
+        try:
+            digests = self._pipe.hash_blocks([raw for _, _, _, raw in batch])
+            if self.meta is not None:
+                self.meta.set_block_digests(
+                    [
+                        (sid, indx, bsize, digests[i])
+                        for i, (sid, indx, bsize, _) in enumerate(batch)
+                    ]
+                )
+            self.blocks += len(batch)
+            self.bytes += sum(bsize for _, _, bsize, _ in batch)
+        except Exception as e:
+            # The index is advisory (gc backfills missing rows); never let
+            # an indexing failure poison the write path.
+            self.errors += len(batch)
+            logger.warning("index batch of %d failed: %s", len(batch), e)
+        finally:
+            self.busy_seconds += time.perf_counter() - t0
+            with self._cond:
+                self._pending -= len(batch)
+                self._cond.notify_all()
+
+    # -- lifecycle ---------------------------------------------------------
+    def flush(self, timeout: float = 60.0) -> None:
+        """Block until every submitted block has been hashed + persisted."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._pending == 0, timeout):
+                raise TimeoutError("block indexer did not drain")
+
+    def close(self, timeout: float = 60.0) -> None:
+        self.flush(timeout)
+        self._q.put(_STOP)
+        self._thread.join(timeout)
+
+    def stats(self) -> dict:
+        return {
+            "backend": self._pipe.config.backend,
+            "blocks": self.blocks,
+            "bytes": self.bytes,
+            "busy_seconds": round(self.busy_seconds, 3),
+            "hash_mib_s": round(
+                self.bytes / (1 << 20) / self.busy_seconds, 1
+            ) if self.busy_seconds > 0 else 0.0,
+            "errors": self.errors,
+        }

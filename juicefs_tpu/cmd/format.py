@@ -25,7 +25,10 @@ def add_parser(sub):
     p.add_argument("--capacity", type=int, default=0, help="capacity GiB (0=unlimited)")
     p.add_argument("--inodes", type=int, default=0)
     p.add_argument("--trash-days", type=int, default=1)
-    p.add_argument("--hash-backend", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--hash-backend", default="",
+                   choices=["", "none", "cpu", "tpu", "xla", "pallas"],
+                   help="fingerprint every written block into the meta "
+                        "content index using this hash plane")
     p.add_argument("--encrypt-rsa-key", default="", help="PEM private key path")
     p.add_argument("--force", action="store_true", help="overwrite existing format")
     p.set_defaults(func=run)
@@ -42,7 +45,7 @@ def run(args) -> int:
         capacity=args.capacity << 30,
         inodes=args.inodes,
         trash_days=args.trash_days,
-        hash_backend=args.hash_backend,
+        hash_backend="" if args.hash_backend == "none" else args.hash_backend,
     )
     if args.encrypt_rsa_key:
         with open(args.encrypt_rsa_key) as f:

@@ -64,13 +64,21 @@ def run(args) -> int:
             broken.append(str(ino))
 
     if args.verify_data or args.hash_index:
-        backend = args.hash_backend or ("xla" if fmt.hash_backend == "tpu" else "cpu")
+        from ..chunk.indexer import pipeline_backend
         from ..tpu.jth256 import digest_hex
         from ..tpu.pipeline import HashPipeline, PipelineConfig
 
+        backend = args.hash_backend or pipeline_backend(fmt.hash_backend)
         pipe = HashPipeline(
             PipelineConfig(backend=backend, pad_lanes=max(1, bs // 65536))
         )
+        # Digests recorded by the write path (meta content index): a block
+        # whose recomputed digest disagrees is silent corruption the
+        # reference's existence/size fsck cannot see.
+        recorded = {
+            block_key(sid, indx, bsize): digest
+            for sid, indx, bsize, digest in m.scan_block_digests()
+        }
 
         def readable():
             for key, bsize in expected.items():
@@ -82,11 +90,22 @@ def run(args) -> int:
                     logger.error("block %s unreadable: %s", key, e)
                     broken.append(key)
 
-        index = {k: digest_hex(d) for k, d in pipe.hash_stream(readable())}
+        bitrot = 0
+        index = {}
+        for k, d in pipe.hash_stream(readable()):
+            index[k] = digest_hex(d)
+            want = recorded.get(k)
+            if want is not None and want != d:
+                logger.error("block %s content digest mismatch (bitrot?)", k)
+                broken.append(k)
+                bitrot += 1
         if args.hash_index:
             with open(args.hash_index, "w") as f:
                 json.dump(index, f, indent=1)
-        print(f"verified {len(index)} blocks ({backend})")
+        print(
+            f"verified {len(index)} blocks ({backend}); "
+            f"{len(recorded)} indexed, {bitrot} digest mismatches"
+        )
 
     print(f"checked {checked} files / {blocks} blocks; {len(broken)} broken")
     return 1 if broken else 0

@@ -42,7 +42,7 @@ def _build_fs(args):
 
     m, fmt = open_meta(args.meta_url)
     m.new_session(heartbeat=12.0)
-    vfs = VFS(m, build_store(fmt, args), fmt=fmt)
+    vfs = VFS(m, build_store(fmt, args, meta=m), fmt=fmt)
     return FileSystem(vfs), vfs, m
 
 

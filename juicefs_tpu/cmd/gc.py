@@ -92,9 +92,9 @@ def run(args) -> int:
         print(f"deleted {len(leaked)} leaked objects")
 
     if args.dedup:
-        backend = args.hash_backend or (
-            "xla" if fmt.hash_backend == "tpu" else "cpu"
-        )
+        from ..chunk.indexer import pipeline_backend
+
+        backend = args.hash_backend or pipeline_backend(fmt.hash_backend)
         stats = dedup_scan(m, store, live, backend, args.dedup_index, bs)
         print(json.dumps(stats))
     return 0
@@ -102,25 +102,55 @@ def run(args) -> int:
 
 def dedup_scan(meta, store, live: dict[str, int], backend: str,
                index_path: str, block_size: int) -> dict:
-    """Stream every live block through the hash pipeline; group duplicates."""
+    """Content-dedup scan over all live blocks.
+
+    Incremental: digests recorded by the write path (meta content index,
+    kv.py `B` keys) are trusted as-is; only blocks missing from the index
+    are read back and hashed, and their rows are backfilled so the next
+    scan is O(new data). Index rows whose slice no longer exists are
+    pruned here — the index is advisory and self-healing.
+    """
     from ..tpu.dedup import dedup_digests
     from ..tpu.jth256 import digest_hex
     from ..tpu.pipeline import HashPipeline, PipelineConfig
 
-    pad_lanes = max(1, block_size // 65536)
-    pipe = HashPipeline(PipelineConfig(backend=backend, pad_lanes=pad_lanes))
+    # 1. load the persistent index; prune rows for dead slices
+    digest_by_key: dict[str, bytes] = {}
+    stale: list[tuple[int, int]] = []
+    for sid, indx, bsize, digest in meta.scan_block_digests():
+        key = block_key(sid, indx, bsize)
+        if key in live:
+            digest_by_key[key] = digest
+        else:
+            stale.append((sid, indx))
+    if stale:
+        meta.delete_block_digests(stale)
+    indexed = len(digest_by_key)
+
+    # 2. hash only blocks the write path didn't index; backfill their rows
+    missing = [k for k in live if k not in digest_by_key]
+    pipe = HashPipeline(
+        PipelineConfig(backend=backend, pad_lanes=max(1, block_size // 65536))
+    )
 
     def blocks():
-        for key, bsize in live.items():
+        for key in missing:
             try:
-                yield key, store._load_block(key, bsize, cache_after=False)
+                yield key, store._load_block(key, live[key], cache_after=False)
             except Exception as e:
                 logger.warning("read %s: %s", key, e)
 
-    keys, digests = [], []
+    backfill = []
     for key, digest in pipe.hash_stream(blocks()):
-        keys.append(key)
-        digests.append(digest)
+        digest_by_key[key] = digest
+        sid, indx, bsize = parse_block_key(key)
+        backfill.append((sid, indx, bsize, digest))
+    if backfill:
+        meta.set_block_digests(backfill)
+
+    # 3. duplicate grouping over the full digest set
+    keys = list(digest_by_key)
+    digests = [digest_by_key[k] for k in keys]
     dup_mask, first_idx = dedup_digests(digests)
     dup_bytes = sum(live[keys[i]] for i, d in enumerate(dup_mask) if d)
     groups: dict[str, list[str]] = {}
@@ -137,6 +167,9 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
     return {
         "blocks": len(keys),
         "bytes": sum(live.values()),
+        "from_index": indexed,
+        "hashed_now": len(backfill),
+        "stale_index_rows_removed": len(stale),
         "duplicate_blocks": int(dup_mask.sum()),
         "duplicate_bytes": int(dup_bytes),
         "dedup_groups": len(groups),

@@ -49,7 +49,7 @@ def serve(args) -> int:
 
     m, fmt = open_meta(args.meta_url)
     m.new_session(heartbeat=12.0)
-    store = build_store(fmt, args)
+    store = build_store(fmt, args, meta=m)
     vfs = VFS(
         m,
         store,
@@ -83,6 +83,11 @@ def serve(args) -> int:
         if bg is not None:
             bg.stop()
         vfs.close()
+        if store.indexer is not None:
+            try:
+                store.indexer.close()
+            except Exception as e:
+                logger.warning("content indexer drain on unmount: %s", e)
         m.close_session()
     return 0
 

@@ -23,6 +23,11 @@ def add_parser(sub):
     p.add_argument("--big-object-size", type=int, default=64, help="total MiB")
     p.add_argument("--small-objects", type=int, default=64)
     p.add_argument("--threads", type=int, default=4)
+    p.add_argument("--compress", default="", choices=["", "none", "lz4", "zstd"],
+                   help="compress each object in the put path")
+    p.add_argument("--hash-backend", default="",
+                   help="cpu|xla|pallas: fingerprint each block in the put "
+                        "path and report hash MiB/s (BASELINE config #5)")
     p.set_defaults(func=run)
 
 
@@ -65,14 +70,54 @@ def run(args) -> int:
 
     bs = args.block_size << 20
     n = max(1, (args.big_object_size << 20) // bs)
-    payload = os.urandom(bs)
     keys = [f"objbench/big/{i}" for i in range(n)]
+    # distinct payloads: identical blocks would make compression and the
+    # dedup-style hash stream unrealistically cheap; generated per put so
+    # the 10 GiB config never holds the data set in memory
+    seed = os.urandom(bs)
+
+    def payload(i: int) -> bytes:
+        r = i % bs
+        return seed[r:] + seed[:r]
+
+    compressor = None
+    if args.compress and args.compress != "none":
+        from ..compress import new_compressor
+
+        compressor = new_compressor(args.compress)
+    indexer = None
+    if args.hash_backend:
+        from ..chunk.indexer import BlockIndexer, pipeline_backend
+
+        indexer = BlockIndexer(
+            meta=None, backend=pipeline_backend(args.hash_backend), block_size=bs
+        )
+
+    def put_one(item):
+        """The full write-path block pipeline: fingerprint -> compress ->
+        PUT (role-match to chunk/cached_store._put_block)."""
+        i, k = item
+        data = payload(i)
+        if indexer is not None:
+            indexer.submit_raw(0, i, bs, data)
+        if compressor is not None:
+            data = compressor.compress(data)
+        store.put(k, data)
+
+    def get_one(k):
+        data = bytes(store.get(k))
+        if compressor is not None:
+            data = compressor.decompress(data, bs)
+        return len(data)
+
     with ThreadPoolExecutor(max_workers=args.threads) as pool:
         t0 = time.perf_counter()
-        list(pool.map(lambda k: store.put(k, payload), keys))
+        list(pool.map(put_one, enumerate(keys)))
+        if indexer is not None:
+            indexer.flush()
         put_dt = time.perf_counter() - t0
         t0 = time.perf_counter()
-        list(pool.map(lambda k: bytes(store.get(k)), keys))
+        list(pool.map(get_one, keys))
         get_dt = time.perf_counter() - t0
         list(pool.map(store.delete, keys))
 
@@ -84,10 +129,16 @@ def run(args) -> int:
         sput_dt = time.perf_counter() - t0
         list(pool.map(store.delete, skeys))
 
-    print(json.dumps({
+    result = {
         "put_MiB_s": round(n * bs / (1 << 20) / put_dt, 2),
         "get_MiB_s": round(n * bs / (1 << 20) / get_dt, 2),
         "small_put_objs_s": round(len(skeys) / sput_dt, 1),
         "functional_failures": failures,
-    }))
+    }
+    if args.compress and args.compress != "none":
+        result["compress"] = args.compress
+    if indexer is not None:
+        result["hash"] = indexer.stats()
+        indexer.close()
+    print(json.dumps(result))
     return 1 if failures else 0

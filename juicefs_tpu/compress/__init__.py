@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import ctypes
 import ctypes.util
+import threading
 from typing import Optional
 
 __all__ = ["Compressor", "new_compressor", "NoneCompressor", "LZ4Compressor", "ZstdCompressor"]
@@ -97,24 +98,39 @@ class LZ4Compressor(Compressor):
 
 
 class ZstdCompressor(Compressor):
-    """Zstd level 1 (reference compress.go:71: DataDog/zstd level 1)."""
+    """Zstd level 1 (reference compress.go:71: DataDog/zstd level 1).
+
+    zstandard context objects wrap a single ZSTD_CCtx/DCtx and are NOT
+    thread safe — concurrent compress() on one instance segfaults. The
+    chunk store's upload pool and objbench both compress from worker
+    threads, so contexts are per-thread here (the reference gets this for
+    free: DataDog/zstd's stateless API creates a cctx per call).
+    """
 
     name = "zstd"
 
     def __init__(self, level: int = 1):
         import zstandard
 
-        self._c = zstandard.ZstdCompressor(level=level)
-        self._d = zstandard.ZstdDecompressor()
+        self._zstd = zstandard
+        self._level = level
+        self._local = threading.local()
+
+    def _ctxs(self):
+        c = getattr(self._local, "c", None)
+        if c is None:
+            self._local.c = self._zstd.ZstdCompressor(level=self._level)
+            self._local.d = self._zstd.ZstdDecompressor()
+        return self._local
 
     def compress_bound(self, n: int) -> int:
         return n + (n >> 8) + 64
 
     def compress(self, data: bytes) -> bytes:
-        return self._c.compress(data)
+        return self._ctxs().c.compress(data)
 
     def decompress(self, data: bytes, dst_size: int) -> bytes:
-        return self._d.decompress(data, max_output_size=dst_size)
+        return self._ctxs().d.decompress(data, max_output_size=dst_size)
 
 
 def new_compressor(algo: str) -> Compressor:

@@ -137,6 +137,8 @@ class FileSystem:
         if st:
             raise FSError(st, path)
         st, n = self.vfs.meta.remove_recursive(self.ctx, parent, name, skip_trash=False)
+        # bulk removal bypassed the VFS per-op invalidation hooks
+        self.vfs.cache.clear()
         if st and st != _errno.ENOENT:
             raise FSError(st, path)
         return n

@@ -13,6 +13,11 @@ the cleanest of its three engines — SURVEY.md §7.1):
     A{ino8}S                     -> symlink target
     A{ino8}X{name}               -> xattr value
     A{ino8}P{parent8}            -> hard-link parent refcount (u32)
+    B{sliceid8}{indx4}           -> content index: bsize(u32) + JTH-256
+                                    digest(32B) of the raw block (TPU
+                                    fingerprint plane; no reference
+                                    equivalent — the reference addresses
+                                    blocks by slice id only)
     D{ino8}{length8}             -> deleted file pending data reclaim (ts f64)
     K{sliceid8}{size4}           -> slice refcount delta (i64; absent == 1)
     F{ino8}                      -> BSD flock table (JSON)
@@ -217,6 +222,10 @@ class KVMeta(BaseMeta):
     @staticmethod
     def _dirquota_key(ino: int) -> bytes:
         return b"QD" + ino.to_bytes(8, "big")
+
+    @staticmethod
+    def _blockdigest_key(sid: int, indx: int) -> bytes:
+        return b"B" + sid.to_bytes(8, "big") + indx.to_bytes(4, "big")
 
     # ---- txn-scoped helpers ---------------------------------------------
     def _get_attr(self, tx: KVTxn, ino: int) -> Optional[Attr]:
@@ -1168,6 +1177,53 @@ class KVMeta(BaseMeta):
                 ino = int.from_bytes(k[1:9], "big")
                 indx = int.from_bytes(k[10:14], "big")
                 yield (ino, indx), Slice.decode_list(v)
+
+    # ---- content-hash index (TPU fingerprint plane) ----------------------
+    # Persists the write path's JTH-256 block digests so gc --dedup and
+    # fsck consume an index instead of re-hashing the volume. The index is
+    # advisory: entries for deleted slices are garbage-collected by the
+    # next gc sweep, and missing entries are backfilled there too, so a
+    # lost write can never corrupt anything.
+
+    def set_block_digests(
+        self, entries: list[tuple[int, int, int, bytes]]
+    ) -> None:
+        """Record (sliceid, indx, bsize, digest32) rows, batched per txn."""
+        for i in range(0, len(entries), 1024):
+            batch = entries[i:i + 1024]
+
+            def fn(tx: KVTxn, batch=batch):
+                for sid, indx, bsize, digest in batch:
+                    tx.set(
+                        self._blockdigest_key(sid, indx),
+                        bsize.to_bytes(4, "big") + digest,
+                    )
+                return 0
+
+            self.client.txn(fn)
+
+    def scan_block_digests(self):
+        """Yield (sliceid, indx, bsize, digest32) for every indexed block."""
+        for k, v in self.client.scan(b"B", next_key(b"B")):
+            if len(k) == 13 and len(v) >= 36:
+                yield (
+                    int.from_bytes(k[1:9], "big"),
+                    int.from_bytes(k[9:13], "big"),
+                    int.from_bytes(v[:4], "big"),
+                    bytes(v[4:36]),
+                )
+
+    def delete_block_digests(self, pairs: list[tuple[int, int]]) -> None:
+        """Drop index rows for (sliceid, indx) pairs, batched per txn."""
+        for i in range(0, len(pairs), 1024):
+            batch = pairs[i:i + 1024]
+
+            def fn(tx: KVTxn, batch=batch):
+                for sid, indx in batch:
+                    tx.delete(self._blockdigest_key(sid, indx))
+                return 0
+
+            self.client.txn(fn)
 
     # ---- dir quotas (reference pkg/meta/quota.go:32-44,209,396) ----------
     _QFMT = struct.Struct(">qqqq")  # space_limit inode_limit used_space used_inodes

@@ -258,11 +258,18 @@ class RedisKV(TKVClient):
         except Exception:
             self._drop_conn()  # dead socket: uncache so next use redials
 
+    # Socket failures get their own small retry budget: conflict retries
+    # are cheap and frequent under contention (budget 50), but each network
+    # redial can block for a full connect timeout, so reusing the conflict
+    # budget could stall a single meta op for many minutes.
+    _NET_RETRIES = 3
+
     def txn(self, fn, retries: int = 50):
         active = getattr(self._local, "tx", None)
         if active is not None:
             return fn(active)  # nested: join (single atomic commit)
         last: Exception | None = None
+        net_failures = 0
         for attempt in range(retries):
             committing = False
             try:
@@ -314,6 +321,9 @@ class RedisKV(TKVClient):
                     raise MetaNetworkError(
                         "connection lost while committing; outcome unknown"
                     ) from e
+                net_failures += 1
+                if net_failures >= self._NET_RETRIES:
+                    raise
                 last = e
             except RedisError:
                 # Server-side command error mid-pipeline: later replies are

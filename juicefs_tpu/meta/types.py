@@ -271,10 +271,14 @@ class Format:
     encrypt_algo: str = ""
     key_encrypted: bool = False
     trash_days: int = 1
-    meta_version: int = 1
+    # version 2: hash_backend became an explicit opt-in ("" default);
+    # from_json() uses this to ignore the old implicit "cpu" default
+    meta_version: int = 2
     dir_stats: bool = True
     enable_acl: bool = False
-    hash_backend: str = "cpu"  # "cpu" | "tpu": block fingerprint plane
+    # "" = no content indexing; "cpu"|"tpu"|"xla"|"pallas" = fingerprint
+    # every written block and persist digests in the meta content index
+    hash_backend: str = ""
 
     def __post_init__(self):
         if not self.uuid:
@@ -286,6 +290,11 @@ class Format:
     @classmethod
     def from_json(cls, data: str | bytes) -> "Format":
         raw = json.loads(data)
+        if raw.get("meta_version", 1) < 2 and raw.get("hash_backend") == "cpu":
+            # v1 volumes stored "cpu" as an implicit default, before content
+            # indexing existed as a feature; only an explicit (v2+) value
+            # may opt a volume into write-path fingerprinting.
+            raw["hash_backend"] = ""
         known = {f for f in cls.__dataclass_fields__}
         return cls(**{k: v for k, v in raw.items() if k in known})
 

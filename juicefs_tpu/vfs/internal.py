@@ -103,6 +103,8 @@ class ControlHandler:
             ctx, int(cmd["parent"]), cmd["name"].encode(),
             skip_trash=bool(cmd.get("skip_trash")),
         )
+        # bulk namespace change bypassed the per-op invalidation hooks
+        self.vfs.cache.clear()
         return {"errno": st, "removed": removed}
 
     def _op_warmup(self, ctx, cmd):
@@ -141,6 +143,8 @@ class ControlHandler:
         st, new_ino = self.vfs.meta.clone(
             ctx, int(cmd["inode"]), int(cmd["parent"]), cmd["name"].encode()
         )
+        if st == 0:
+            self.vfs.cache.invalidate_attr(int(cmd["parent"]))
         return {"errno": st, "inode": new_ino}
 
 

@@ -36,6 +36,7 @@ from ..meta.types import (
 from ..metric import global_registry
 from ..utils import get_logger
 from .accesslog import AccessLogger
+from .cache import MetaCache
 from .handles import Handle, HandleTable
 from .internal import INTERNAL_NAMES, InternalFiles, internal_attr, is_internal
 from .reader import DataReader
@@ -75,6 +76,9 @@ class VFS:
         self.writer = DataWriter(meta, store)
         self.reader = DataReader(meta, store, self.conf.max_readahead, writer=self.writer)
         self._append_lock = threading.Lock()
+        # entry/attr TTL caches (vfs/cache.py): kernel-style caching for
+        # every adapter; local mutations invalidate synchronously below
+        self.cache = MetaCache(self.conf.attr_timeout, self.conf.entry_timeout)
         self.accesslog = AccessLogger()
         self.internal = InternalFiles(self)
         self._op_hist = global_registry().histogram(
@@ -138,20 +142,45 @@ class VFS:
         if parent == ROOT_INO and name in INTERNAL_NAMES:
             ino, attr = self.internal.lookup(name)
             return 0, ino, attr
-        return self.meta.lookup(ctx, parent, name)
+        # "." / ".." resolve relative to a directory whose parentage can
+        # change under rename with no (parent, name) key to invalidate —
+        # never cache them.
+        cacheable = name not in (b".", b"..")
+        if cacheable:
+            ino = self.cache.get_entry(parent, name)
+            if ino is not None:
+                attr = self.cache.get_attr(ino)
+                if attr is not None:
+                    return 0, ino, self._overlay_length(ino, attr)
+        st, ino, attr = self.meta.lookup(ctx, parent, name)
+        if st == 0:
+            if cacheable:
+                self.cache.put_entry(parent, name, ino)
+                self.cache.put_attr(ino, attr)
+            attr = self._overlay_length(ino, attr)
+        return st, ino, attr
 
-    def getattr(self, ctx: Context, ino: int) -> tuple[int, Attr]:
-        if is_internal(ino):
-            return 0, internal_attr(ino)
-        st, attr = self.meta.getattr(ctx, ino)
-        if st == 0 and attr.typ == TYPE_FILE:
-            # Surface buffered writes in stat (reference UpdateLength). Copy
-            # first: meta may have handed us its cached Attr instance, and
-            # mutating it would poison the open-file cache.
+    def _overlay_length(self, ino: int, attr: Attr) -> Attr:
+        """Surface buffered writes in stat (reference UpdateLength). Copy
+        first: the attr may be a cached instance (meta openfile cache or
+        our TTL cache) and mutating it would poison the cache."""
+        if attr.typ == TYPE_FILE:
             wlen = self.writer.get_length(ino)
             if wlen is not None and wlen > attr.length:
                 attr = replace(attr)
                 attr.length = wlen
+        return attr
+
+    def getattr(self, ctx: Context, ino: int) -> tuple[int, Attr]:
+        if is_internal(ino):
+            return 0, internal_attr(ino)
+        attr = self.cache.get_attr(ino)
+        if attr is not None:
+            return 0, self._overlay_length(ino, attr)
+        st, attr = self.meta.getattr(ctx, ino)
+        if st == 0:
+            self.cache.put_attr(ino, attr)
+            attr = self._overlay_length(ino, attr)
         return st, attr
 
     def setattr(self, ctx: Context, ino: int, flags: int, attr: Attr) -> tuple[int, Attr]:
@@ -164,26 +193,51 @@ class VFS:
             if st != 0:
                 return st, Attr()
         st, out = self.meta.setattr(ctx, ino, flags, attr)
-        if st == 0 and flags & SET_ATTR_SIZE:
-            self.writer.truncate(ino, out.length)
+        if st == 0:
+            self.cache.put_attr(ino, out)
+            if flags & SET_ATTR_SIZE:
+                self.writer.truncate(ino, out.length)
         return st, out
+
+    def _entry_created(self, parent: int, name: bytes, ino: int, attr: Attr) -> None:
+        """Cache bookkeeping after a successful namespace insert: the new
+        dentry/attr are known exactly; the parent's attr (mtime, nlink for
+        mkdir) changed in meta, so drop it."""
+        self.cache.invalidate_attr(parent)
+        self.cache.put_entry(parent, name, ino)
+        self.cache.put_attr(ino, attr)
+
+    def _entry_removed(self, parent: int, name: bytes) -> None:
+        ino = self.cache.invalidate_entry(parent, name)
+        self.cache.invalidate_attr(parent)
+        if ino is not None:
+            self.cache.invalidate_attr(ino)  # nlink/ctime changed
 
     def mknod(self, ctx, parent, name, mode, cumask=0, rdev=0) -> tuple[int, int, Attr]:
         if self.conf.readonly:
             return _errno.EROFS, 0, Attr()
-        return self.meta.mknod(ctx, parent, name, TYPE_FILE, mode, cumask, rdev)
+        st, ino, attr = self.meta.mknod(ctx, parent, name, TYPE_FILE, mode, cumask, rdev)
+        if st == 0:
+            self._entry_created(parent, name, ino, attr)
+        return st, ino, attr
 
     def mkdir(self, ctx, parent, name, mode, cumask=0) -> tuple[int, int, Attr]:
         if self.conf.readonly:
             return _errno.EROFS, 0, Attr()
-        return self.meta.mkdir(ctx, parent, name, mode, cumask)
+        st, ino, attr = self.meta.mkdir(ctx, parent, name, mode, cumask)
+        if st == 0:
+            self._entry_created(parent, name, ino, attr)
+        return st, ino, attr
 
     def symlink(self, ctx, parent, name, target: bytes) -> tuple[int, int, Attr]:
         if self.conf.readonly:
             return _errno.EROFS, 0, Attr()
         if len(target) >= MAX_SYMLINK:
             return _errno.ENAMETOOLONG, 0, Attr()
-        return self.meta.symlink(ctx, parent, name, target)
+        st, ino, attr = self.meta.symlink(ctx, parent, name, target)
+        if st == 0:
+            self._entry_created(parent, name, ino, attr)
+        return st, ino, attr
 
     def readlink(self, ctx, ino) -> tuple[int, bytes]:
         return self.meta.readlink(ctx, ino)
@@ -191,17 +245,30 @@ class VFS:
     def unlink(self, ctx, parent, name) -> int:
         if self.conf.readonly:
             return _errno.EROFS
-        return self.meta.unlink(ctx, parent, name)
+        st = self.meta.unlink(ctx, parent, name)
+        if st == 0:
+            self._entry_removed(parent, name)
+        return st
 
     def rmdir(self, ctx, parent, name) -> int:
         if self.conf.readonly:
             return _errno.EROFS
-        return self.meta.rmdir(ctx, parent, name)
+        st = self.meta.rmdir(ctx, parent, name)
+        if st == 0:
+            self._entry_removed(parent, name)
+        return st
 
     def rename(self, ctx, psrc, nsrc, pdst, ndst, flags=0) -> tuple[int, int, Attr]:
         if self.conf.readonly:
             return _errno.EROFS, 0, Attr()
-        return self.meta.rename(ctx, psrc, nsrc, pdst, ndst, flags)
+        st, ino, attr = self.meta.rename(ctx, psrc, nsrc, pdst, ndst, flags)
+        if st == 0:
+            self._entry_removed(psrc, nsrc)
+            self._entry_removed(pdst, ndst)  # replaced target (if any)
+            if not flags:  # EXCHANGE/WHITEOUT: leave both uncached
+                self.cache.put_entry(pdst, ndst, ino)
+                self.cache.put_attr(ino, attr)
+        return st, ino, attr
 
     def link(self, ctx, ino, parent, name) -> tuple[int, Attr]:
         if self.conf.readonly:
@@ -209,7 +276,10 @@ class VFS:
         st = self.writer.flush(ino)
         if st != 0:
             return st, Attr()
-        return self.meta.link(ctx, ino, parent, name)
+        st, attr = self.meta.link(ctx, ino, parent, name)
+        if st == 0:
+            self._entry_created(parent, name, ino, attr)
+        return st, attr
 
     # -- directories -------------------------------------------------------
 
@@ -250,6 +320,7 @@ class VFS:
         st, ino, attr = self.meta.create(ctx, parent, name, mode, cumask, flags)
         if st != 0:
             return st, 0, Attr(), 0
+        self._entry_created(parent, name, ino, attr)
         fh = self._new_file_handle(ino, attr.length, flags)
         return 0, ino, attr, fh
 
@@ -337,6 +408,7 @@ class VFS:
             st = h.writer.flush()
             if st != 0:
                 return st
+            self.cache.invalidate_attr(ino)  # committed length/mtime
         # Drop this owner's POSIX locks on close, per POSIX close(2).
         if lock_owner and hasattr(self.meta, "setlk"):
             self.meta.setlk(
@@ -358,6 +430,7 @@ class VFS:
         st = 0
         if h.writer is not None:
             st = self.writer.close(ino)
+            self.cache.invalidate_attr(ino)
         self.meta.close(ctx, ino)
         return st
 
@@ -369,6 +442,7 @@ class VFS:
             return st, Attr()
         st, attr = self.meta.truncate(ctx, ino, length)
         if st == 0:
+            self.cache.put_attr(ino, attr)
             self.writer.truncate(ino, length)
         return st, attr
 
@@ -383,7 +457,10 @@ class VFS:
         st = self.writer.flush(ino)
         if st != 0:
             return st
-        return self.meta.fallocate(ctx, ino, mode, off, size)
+        st = self.meta.fallocate(ctx, ino, mode, off, size)
+        if st == 0:
+            self.cache.invalidate_attr(ino)
+        return st
 
     def copy_file_range(
         self, ctx: Context, fin: int, off_in: int, fout: int, off_out: int,
@@ -395,7 +472,10 @@ class VFS:
             st = self.writer.flush(ino)
             if st != 0:
                 return st, 0
-        return self.meta.copy_file_range(ctx, fin, off_in, fout, off_out, size, flags)
+        st, copied = self.meta.copy_file_range(ctx, fin, off_in, fout, off_out, size, flags)
+        if st == 0:
+            self.cache.invalidate_attr(fout)
+        return st, copied
 
     # -- xattr / statfs ----------------------------------------------------
 
@@ -405,7 +485,10 @@ class VFS:
     def setxattr(self, ctx, ino, name, value, flags=0) -> int:
         if self.conf.readonly:
             return _errno.EROFS
-        return self.meta.setxattr(ctx, ino, name, value, flags)
+        st = self.meta.setxattr(ctx, ino, name, value, flags)
+        if st == 0:
+            self.cache.invalidate_attr(ino)  # ctime changed
+        return st
 
     def listxattr(self, ctx, ino) -> tuple[int, list[bytes]]:
         return self.meta.listxattr(ctx, ino)
@@ -413,7 +496,10 @@ class VFS:
     def removexattr(self, ctx, ino, name) -> int:
         if self.conf.readonly:
             return _errno.EROFS
-        return self.meta.removexattr(ctx, ino, name)
+        st = self.meta.removexattr(ctx, ino, name)
+        if st == 0:
+            self.cache.invalidate_attr(ino)
+        return st
 
     def statfs(self, ctx) -> tuple[int, int, int, int]:
         return self.meta.statfs(ctx)

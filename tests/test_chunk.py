@@ -204,3 +204,56 @@ def test_concurrent_readers_singleflight():
     assert all(r == data for r in results)
     full_gets = [k for k in gets if k == block_key(41, 0, 65536)]
     assert len(full_gets) == 1  # deduped by singleflight
+
+
+@pytest.mark.parametrize("algo", ["lz4", "zstd"])
+def test_compressor_thread_safety(algo):
+    """Concurrent (de)compression on ONE shared compressor: the upload pool
+    and objbench share an instance across worker threads; zstandard ctx
+    objects are not thread safe and used to segfault here."""
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    from juicefs_tpu.compress import new_compressor
+
+    comp = new_compressor(algo)
+    payloads = [os.urandom(1 << 20) + bytes(1 << 20) for _ in range(16)]
+
+    def roundtrip(p):
+        c = comp.compress(p)
+        assert comp.decompress(c, len(p)) == p
+        return len(c)
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        sizes = list(pool.map(roundtrip, payloads * 4))
+    assert all(0 < s < 2 << 20 for s in sizes)
+
+
+def test_multi_block_read_parallel():
+    """Cold-cache multi-block reads fan out over the download pool
+    (VERDICT r2 #7): with per-GET latency L and B blocks, wall time must be
+    far below the serial B*L (reference reader.go:160 async workers)."""
+    import time as _time
+
+    from juicefs_tpu.object.mem import MemStorage
+
+    DELAY, BS, NBLOCKS = 0.03, 1 << 18, 8
+
+    class SlowMem(MemStorage):
+        def get(self, key, off=0, size=-1):
+            _time.sleep(DELAY)
+            return super().get(key, off, size)
+
+    store = CachedStore(SlowMem(), ChunkConfig(block_size=BS, max_download=8))
+    data = os.urandom(BS * NBLOCKS)
+    w = store.new_writer(77)
+    w.write_at(data, 0)
+    w.finish(len(data))
+    store.evict_cache(77, len(data))  # force cold cache
+
+    t0 = _time.perf_counter()
+    got = store.new_reader(77, len(data)).read(0, len(data))
+    wall = _time.perf_counter() - t0
+    assert got == data
+    serial = NBLOCKS * DELAY
+    assert wall < serial / 2, f"read took {wall:.3f}s, serial would be {serial:.3f}s"

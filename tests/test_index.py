@@ -1,0 +1,161 @@
+"""Persistent content-hash index: write-path fingerprinting -> meta `B`
+rows -> incremental gc --dedup and fsck bitrot detection (VERDICT r2 #3;
+role-match to the reference upload hook pkg/chunk/cached_store.go:371-413,
+which only compresses — content addressing is this framework's TPU-first
+addition)."""
+
+import json
+import os
+
+import pytest
+
+from juicefs_tpu.chunk.cached_store import block_key
+from juicefs_tpu.cmd import main
+from juicefs_tpu.meta.context import Context
+from juicefs_tpu.tpu.jth256 import jth256
+from juicefs_tpu.vfs import ROOT_INO
+
+CTX = Context(uid=0, gid=0, pid=1)
+
+
+@pytest.fixture
+def vol(tmp_path):
+    meta_url = f"sqlite3://{tmp_path}/meta.db"
+    bucket = str(tmp_path / "blobs")
+    rc = main([
+        "format", meta_url, "hashvol",
+        "--storage", "file", "--bucket", bucket, "--block-size", "256",
+        "--hash-backend", "cpu", "--trash-days", "0",
+    ])
+    assert rc == 0
+    return meta_url, bucket, tmp_path
+
+
+def _open_vfs(meta_url, tmp_path, n=0):
+    from juicefs_tpu.cmd import build_store, open_meta
+    from juicefs_tpu.vfs import VFS
+
+    class A:
+        cache_dir = str(tmp_path / f"cache{n}")
+        writeback = False
+        cache_size = 0
+
+    m, fmt = open_meta(meta_url)
+    m.new_session()
+    return VFS(m, build_store(fmt, A(), meta=m), fmt=fmt)
+
+
+def _write_file(v, name: bytes, data: bytes) -> int:
+    st, ino, _, fh = v.create(CTX, ROOT_INO, name, 0o644)
+    assert st == 0
+    assert v.write(CTX, ino, fh, 0, data) == 0
+    assert v.release(CTX, ino, fh) == 0
+    return ino
+
+
+def test_write_path_indexes_blocks(vol):
+    meta_url, bucket, tmp = vol
+    v = _open_vfs(meta_url, tmp)
+    assert v.store.indexer is not None  # build_store wired the hook
+    data = os.urandom(300_000)  # 2 blocks at 256 KiB
+    _write_file(v, b"a.bin", data)
+    v.store.indexer.flush()
+
+    rows = list(v.meta.scan_block_digests())
+    assert len(rows) == 2
+    # digests must equal the spec hash of the exact raw block bytes
+    for sid, indx, bsize, digest in rows:
+        raw = v.store._load_block(block_key(sid, indx, bsize), bsize)
+        assert digest == jth256(raw)
+    sizes = sorted(bsize for _, _, bsize, _ in rows)
+    assert sizes == [300_000 - 262_144, 262_144]
+    assert v.store.indexer.stats()["blocks"] == 2
+    v.close()
+
+
+def test_gc_dedup_consumes_index(vol, capsys):
+    meta_url, bucket, tmp = vol
+    v = _open_vfs(meta_url, tmp)
+    blob = os.urandom(100_000)
+    _write_file(v, b"a.bin", blob)
+    _write_file(v, b"b.bin", blob)  # identical content
+    _write_file(v, b"c.bin", os.urandom(50_000))
+    v.store.indexer.flush()
+    v.close()
+
+    assert main(["gc", meta_url, "--dedup"]) == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # every live block was already fingerprinted by the write path
+    assert stats["blocks"] == 3
+    assert stats["from_index"] == 3
+    assert stats["hashed_now"] == 0
+    assert stats["duplicate_blocks"] == 1
+    assert stats["dedup_groups"] == 1
+
+
+def test_gc_dedup_backfills_and_prunes(vol, capsys):
+    meta_url, bucket, tmp = vol
+    from juicefs_tpu.meta import interface as mi
+
+    v = _open_vfs(meta_url, tmp)
+    # slice reclaim handler, as mount registers (cmd/mount.py)
+    v.meta.on_msg(mi.DELETE_SLICE, lambda sid, size: v.store.remove(sid, size))
+    ino = _write_file(v, b"kept.bin", os.urandom(64_000))
+    vic = _write_file(v, b"gone.bin", os.urandom(64_000))
+    v.store.indexer.flush()
+    # drop one file: its index rows become stale (trash disabled)
+    assert v.unlink(CTX, ROOT_INO, b"gone.bin") == 0
+    v.meta.cleanup_deleted_files()  # reclaim, as the bg job would
+    # and simulate a block written by a client without indexing
+    v.meta.delete_block_digests(
+        [(sid, indx) for sid, indx, _, _ in v.meta.scan_block_digests()][:1]
+    )
+    before = {(s, i) for s, i, _, _ in v.meta.scan_block_digests()}
+    v.close()
+
+    assert main(["gc", meta_url, "--dedup", "--age", "0"]) == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["blocks"] == 1  # only kept.bin's block is live
+    assert stats["hashed_now"] == 1  # the dropped row was backfilled
+    # stale rows (deleted file) were pruned from the index
+    m_v = _open_vfs(meta_url, tmp, 1)
+    after = list(m_v.meta.scan_block_digests())
+    assert len(after) == 1
+    raw = m_v.store._load_block(
+        block_key(after[0][0], after[0][1], after[0][2]), after[0][2]
+    )
+    assert after[0][3] == jth256(raw)
+    m_v.close()
+    assert before != after  # index actually changed
+
+
+def test_fsck_detects_bitrot(vol, capsys):
+    meta_url, bucket, tmp = vol
+    v = _open_vfs(meta_url, tmp)
+    _write_file(v, b"rot.bin", os.urandom(100_000))
+    v.store.indexer.flush()
+    # flip bytes inside the stored object: size unchanged, content wrong —
+    # invisible to the reference's existence/size fsck
+    key = [o.key for o in v.store.storage.list_all("chunks/")][0]
+    good = bytes(v.store.storage.get(key))
+    corrupted = good[:50] + bytes([good[50] ^ 0xFF]) + good[51:]
+    v.store.storage.put(key, corrupted)
+    v.close()
+
+    assert main(["fsck", meta_url]) == 0  # size check alone passes
+    capsys.readouterr()
+    assert main(["fsck", meta_url, "--verify-data"]) == 1
+    out = capsys.readouterr().out
+    assert "1 digest mismatches" in out
+
+
+def test_indexer_ignores_foreign_keys(tmp_path):
+    from juicefs_tpu.chunk.indexer import BlockIndexer
+
+    idx = BlockIndexer(meta=None, backend="cpu", block_size=1 << 18)
+    idx.submit("not-a-chunk-key", b"xyz")  # silently skipped
+    idx.submit(block_key(7, 0, 5), b"hello")
+    idx.flush()
+    s = idx.stats()
+    assert s["blocks"] == 1 and s["bytes"] == 5 and s["errors"] == 0
+    idx.close()
