@@ -25,6 +25,8 @@ def add_parser(sub):
     p.add_argument("--capacity", type=int, default=0, help="capacity GiB (0=unlimited)")
     p.add_argument("--inodes", type=int, default=0)
     p.add_argument("--trash-days", type=int, default=1)
+    p.add_argument("--enable-acl", action="store_true",
+                   help="enable POSIX ACLs (system.posix_acl_* xattrs)")
     p.add_argument("--hash-backend", default="",
                    choices=["", "none", "cpu", "tpu", "xla", "pallas"],
                    help="fingerprint every written block into the meta "
@@ -45,6 +47,7 @@ def run(args) -> int:
         capacity=args.capacity << 30,
         inodes=args.inodes,
         trash_days=args.trash_days,
+        enable_acl=args.enable_acl,
         hash_backend="" if args.hash_backend == "none" else args.hash_backend,
     )
     if args.encrypt_rsa_key:

@@ -226,6 +226,11 @@ class Server:
             | k.FUSE_MAX_PAGES
             | k.FUSE_ASYNC_DIO
         )
+        if getattr(self.vfs, "_acl_enabled", lambda: False)():
+            # Kernel-managed ACLs (reference go-fuse EnableAcl): the kernel
+            # caches ACL xattrs and invalidates them on set/remove itself;
+            # without this flag a removexattr can leave a stale cached ACL.
+            ours |= k.FUSE_POSIX_ACL
         out_flags = ours & flags
         return k.INIT_OUT.pack(
             k.FUSE_KERNEL_VERSION,

@@ -161,10 +161,40 @@ class BaseMeta(interface.Meta):
             st, attr = self.do_getattr(ino)
             if st:
                 return st
+        # extended ACL evaluation (reference base.go:871-880; skipped when
+        # the group class is 000, mirroring the kernel's namei.c shortcut)
+        if getattr(attr, "access_acl", 0) and attr.mode & 0o070:
+            rule = self.do_load_acl(attr.access_acl)
+            if rule is not None:
+                gids = (ctx.gid,) + tuple(ctx.gids)
+                if rule.can_access(ctx.uid, gids, attr.uid, attr.gid, mask):
+                    return 0
+                return errno.EACCES
         mode = self._access_mode(attr, ctx)
         if mode & mask != mask:
             return errno.EACCES
         return 0
+
+    def do_load_acl(self, aid: int):
+        """Interned ACL rule by id; engines without ACL support return None."""
+        return None
+
+    # -- POSIX ACLs (reference base.go:2757-2788 SetFacl/GetFacl) ----------
+    def set_facl(self, ctx: Context, ino: int, acl_type: int, rule) -> int:
+        st = self.do_set_facl(ctx, ino, acl_type, rule)
+        if st == 0:
+            self.of.invalidate(ino)
+        return st
+
+    def get_facl(self, ctx: Context, ino: int, acl_type: int):
+        """-> (errno, Rule|None); ENODATA when the inode has no such ACL."""
+        return self.do_get_facl(ino, acl_type)
+
+    def do_set_facl(self, ctx: Context, ino: int, acl_type: int, rule) -> int:
+        return errno.ENOTSUP
+
+    def do_get_facl(self, ino: int, acl_type: int):
+        return errno.ENOTSUP, None
 
     @staticmethod
     def _access_mode(attr: Attr, ctx: Context) -> int:

@@ -19,6 +19,8 @@ the cleanest of its three engines — SURVEY.md §7.1):
                                     equivalent — the reference addresses
                                     blocks by slice id only)
     D{ino8}{length8}             -> deleted file pending data reclaim (ts f64)
+    R{aclid4}                    -> interned POSIX ACL rule (insert-only;
+                                    Attr.access_acl/default_acl point here)
     K{sliceid8}{size4}           -> slice refcount delta (i64; absent == 1)
     F{ino8}                      -> BSD flock table (JSON)
     L{ino8}                      -> POSIX record locks (JSON)
@@ -39,6 +41,7 @@ import time
 from typing import Optional
 
 from ..utils import get_logger
+from . import acl as acl_mod
 from . import interface
 from .base import BaseMeta
 from .context import Context
@@ -102,6 +105,11 @@ class KVMeta(BaseMeta):
         self.client = client
         self._nlocal = threading.local()  # deferred notification buffer
         self._qcache: tuple[set[int], float] | None = None  # quota-roots hint
+        # interned ACL rules by id (reference pkg/acl/cache.go). Only
+        # COMMITTED rows enter this map (reads in _load_acl / post-commit),
+        # never allocations from an open transaction — a conflict-aborted
+        # txn must not leave phantom ids behind.
+        self._acl_cache: dict[int, "acl_mod.Rule"] = {}
 
     def name(self) -> str:
         return self.client.name
@@ -226,6 +234,10 @@ class KVMeta(BaseMeta):
     @staticmethod
     def _blockdigest_key(sid: int, indx: int) -> bytes:
         return b"B" + sid.to_bytes(8, "big") + indx.to_bytes(4, "big")
+
+    @staticmethod
+    def _acl_key(aid: int) -> bytes:
+        return b"R" + aid.to_bytes(4, "big")
 
     # ---- txn-scoped helpers ---------------------------------------------
     def _get_attr(self, tx: KVTxn, ino: int) -> Optional[Attr]:
@@ -447,6 +459,17 @@ class KVMeta(BaseMeta):
                 # non-member setgid clear (POSIX)
                 if ctx.uid != 0 and not ctx.contains_gid(attr.gid) and ctx.check_permission:
                     mode &= ~0o2000
+                if attr.access_acl != acl_mod.ACL_NONE:
+                    # chmod with an ACL: group-class bits become the mask
+                    # (reference tkv.go doSetAttr + acl.go SetMode)
+                    from dataclasses import replace as _rep
+
+                    rule = self._load_acl(tx, attr.access_acl)
+                    if rule is not None:
+                        rule = _rep(rule)
+                        rule.set_mode(mode)
+                        attr.access_acl = self._insert_acl(tx, rule)
+                        mode = (mode & 0o7000) | rule.get_mode()
                 attr.mode = mode
                 changed = True
             if flags & SET_ATTR_UID and attr.uid != new.uid:
@@ -526,7 +549,28 @@ class KVMeta(BaseMeta):
             if st:
                 return st, 0, Attr()
             now = time.time()
-            attr = Attr(typ=typ, mode=mode & ~cumask & 0o7777, uid=ctx.uid, gid=ctx.gid, rdev=rdev)
+            # default-ACL inheritance (reference tkv.go:1136-1162): when the
+            # parent carries a default ACL, the umask is ignored (POSIX) and
+            # the child's access ACL/mode derive from the default rule
+            req_mode = mode & 0o7777
+            child_access = acl_mod.ACL_NONE
+            child_default = acl_mod.ACL_NONE
+            if pattr.default_acl != acl_mod.ACL_NONE and typ != TYPE_SYMLINK:
+                if typ == TYPE_DIRECTORY:
+                    child_default = pattr.default_acl
+                drule = self._load_acl(tx, pattr.default_acl)
+                if drule is None:
+                    eff_mode = req_mode & ~cumask
+                elif drule.is_minimal():
+                    eff_mode = req_mode & (0o7000 | drule.get_mode())
+                else:
+                    crule = drule.child_access_acl(req_mode)
+                    child_access = self._insert_acl(tx, crule)
+                    eff_mode = (req_mode & 0o7000) | crule.get_mode()
+            else:
+                eff_mode = req_mode & ~cumask
+            attr = Attr(typ=typ, mode=eff_mode & 0o7777, uid=ctx.uid, gid=ctx.gid,
+                        rdev=rdev, access_acl=child_access, default_acl=child_default)
             if typ == TYPE_DIRECTORY:
                 attr.nlink = 2
                 attr.length = 4096
@@ -1224,6 +1268,120 @@ class KVMeta(BaseMeta):
                 return 0
 
             self.client.txn(fn)
+
+    # ---- POSIX ACLs (reference pkg/acl, pkg/meta/tkv.go:3594-3689) -------
+    def _load_acl(self, tx: KVTxn, aid: int) -> Optional["acl_mod.Rule"]:
+        """Rule by interned id; cached (rows are insert-only, and callers
+        only pass ids from committed attrs, so a cached entry is always
+        committed data even if the enclosing txn later aborts)."""
+        if aid == acl_mod.ACL_NONE:
+            return None
+        rule = self._acl_cache.get(aid)
+        if rule is None:
+            raw = tx.get(self._acl_key(aid))
+            if raw is None:
+                return None
+            rule = acl_mod.Rule.decode(raw)
+            self._acl_cache[aid] = rule
+        return rule
+
+    def _insert_acl(self, tx: KVTxn, rule: Optional["acl_mod.Rule"]) -> int:
+        """Intern a rule, deduplicating against all persisted rules
+        (reference tkv.go insertACL + tryLoadMissACLs).
+
+        Dedup is purely transaction-local: the R range is scanned inside
+        the txn (engines merge this txn's own buffered inserts into scans),
+        and nothing is published to the in-memory cache here — if the txn
+        aborts or conflict-retries, a cached id would point at a row that
+        was never written, and the id could later be re-allocated to a
+        DIFFERENT rule (wrong-ACL enforcement). The R keyspace is small
+        (rules are shared across inodes), so the scan is cheap.
+        """
+        if rule is None or rule.is_empty():
+            return acl_mod.ACL_NONE
+        enc = rule.encode()
+        for k, v in tx.scan(b"R", next_key(b"R")):
+            if len(k) == 5 and bytes(v) == enc:
+                return int.from_bytes(k[1:5], "big")
+        aid = tx.incr_by(self._counter_key("nextAcl"), 1)
+        tx.set(self._acl_key(aid), enc)
+        return aid
+
+    def do_load_acl(self, aid: int) -> Optional["acl_mod.Rule"]:
+        """Non-txn rule read for access() checks (reference base.go:873)."""
+        if aid == acl_mod.ACL_NONE:
+            return None
+        rule = self._acl_cache.get(aid)
+        if rule is not None:
+            return rule
+        return self.client.simple_txn(lambda tx: self._load_acl(tx, aid))
+
+    def do_set_facl(self, ctx: Context, ino: int, acl_type: int,
+                    rule: "acl_mod.Rule") -> int:
+        """Port of reference tkv.go:3594 doSetFacl: ACL<->mode interplay."""
+        from dataclasses import replace as _rep
+
+        def fn(tx: KVTxn):
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                return errno.ENOENT
+            if ctx.check_permission and ctx.uid != 0 and ctx.uid != attr.uid:
+                return errno.EPERM
+            if attr.flags & FLAG_IMMUTABLE:
+                return errno.EPERM
+            if acl_type == acl_mod.TYPE_DEFAULT and attr.typ != TYPE_DIRECTORY:
+                return errno.EACCES  # default ACLs exist on directories only
+            ori_id = (attr.access_acl if acl_type == acl_mod.TYPE_ACCESS
+                      else attr.default_acl)
+            ori_mode = attr.mode
+            if (acl_type == acl_mod.TYPE_ACCESS and not rule.is_empty()
+                    and ctx.check_permission and ctx.uid != 0
+                    and not ctx.contains_gid(attr.gid)):
+                # Setting an access ACL is mode-changing, so the kernel's
+                # chmod-equivalent sgid kill applies (fuse/acl.c); default-
+                # ACL ops and removals leave the mode untouched.
+                attr.mode &= 0o5777
+            if rule.is_empty():
+                new_id = acl_mod.ACL_NONE
+            elif rule.is_minimal() and acl_type == acl_mod.TYPE_ACCESS:
+                # equivalent to plain mode: store no rule
+                new_id = acl_mod.ACL_NONE
+                attr.mode = (attr.mode & 0o7000) | rule.get_mode()
+            else:
+                r = _rep(rule)
+                r.inherit_perms(attr.mode)
+                new_id = self._insert_acl(tx, r)
+                if acl_type == acl_mod.TYPE_ACCESS:
+                    attr.mode = (attr.mode & 0o7000) | r.get_mode()
+            if acl_type == acl_mod.TYPE_ACCESS:
+                attr.access_acl = new_id
+            else:
+                attr.default_acl = new_id
+            if ori_id != new_id or ori_mode != attr.mode:
+                attr.touch_ctime(time.time())
+                self._set_attr(tx, ino, attr)
+            return 0
+
+        return self.client.txn(fn)
+
+    def do_get_facl(self, ino: int, acl_type: int) -> tuple[int, Optional["acl_mod.Rule"]]:
+        """reference tkv.go:3656 doGetFacl; ENODATA when no such ACL."""
+        from dataclasses import replace as _rep
+
+        def fn(tx: KVTxn):
+            attr = self._get_attr(tx, ino)
+            if attr is None:
+                return errno.ENOENT, None
+            aid = (attr.access_acl if acl_type == acl_mod.TYPE_ACCESS
+                   else attr.default_acl)
+            if aid == acl_mod.ACL_NONE:
+                return errno.ENODATA, None
+            rule = self._load_acl(tx, aid)
+            if rule is None:
+                return errno.EIO, None
+            return 0, _rep(rule)  # copy: callers may mutate
+
+        return self.client.simple_txn(fn)
 
     # ---- dir quotas (reference pkg/meta/quota.go:32-44,209,396) ----------
     _QFMT = struct.Struct(">qqqq")  # space_limit inode_limit used_space used_inodes

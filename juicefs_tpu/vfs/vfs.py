@@ -151,6 +151,17 @@ class VFS:
             if ino is not None:
                 attr = self.cache.get_attr(ino)
                 if attr is not None:
+                    # The dentry is shared across users, so the parent
+                    # execute-permission check meta.lookup would do must
+                    # still run per-caller (cached parent attr avoids the
+                    # round trip on warm walks).
+                    from ..meta.base import MODE_MASK_X
+
+                    st = self.meta.access(
+                        ctx, parent, MODE_MASK_X, self.cache.get_attr(parent)
+                    )
+                    if st != 0:
+                        return st, 0, Attr()
                     return 0, ino, self._overlay_length(ino, attr)
         st, ino, attr = self.meta.lookup(ctx, parent, name)
         if st == 0:
@@ -477,26 +488,73 @@ class VFS:
             self.cache.invalidate_attr(fout)
         return st, copied
 
-    # -- xattr / statfs ----------------------------------------------------
+    # -- xattr / statfs / ACLs ---------------------------------------------
+    # system.posix_acl_* xattrs bridge to GetFacl/SetFacl meta ops with the
+    # kernel wire codec (reference pkg/vfs/vfs.go:1040-1160, 1348-1420).
+
+    _ACL_XATTRS = {
+        b"system.posix_acl_access": 1,   # acl.TYPE_ACCESS
+        b"system.posix_acl_default": 2,  # acl.TYPE_DEFAULT
+    }
+
+    def _acl_enabled(self) -> bool:
+        return bool(self.fmt is not None and self.fmt.enable_acl)
 
     def getxattr(self, ctx, ino, name) -> tuple[int, bytes]:
+        acl_type = self._ACL_XATTRS.get(bytes(name))
+        if acl_type is not None:
+            from ..meta import acl as _acl
+
+            if not self._acl_enabled():
+                return _errno.ENOTSUP, b""
+            st, rule = self.meta.get_facl(ctx, ino, acl_type)
+            if st != 0:
+                return st, b""
+            return 0, _acl.to_xattr(rule)
         return self.meta.getxattr(ctx, ino, name)
 
     def setxattr(self, ctx, ino, name, value, flags=0) -> int:
         if self.conf.readonly:
             return _errno.EROFS
-        st = self.meta.setxattr(ctx, ino, name, value, flags)
+        acl_type = self._ACL_XATTRS.get(bytes(name))
+        if acl_type is not None:
+            from ..meta import acl as _acl
+
+            if not self._acl_enabled():
+                return _errno.ENOTSUP
+            rule = _acl.from_xattr(bytes(value))
+            if rule is None:
+                return _errno.EINVAL
+            st = self.meta.set_facl(ctx, ino, acl_type, rule)
+        else:
+            st = self.meta.setxattr(ctx, ino, name, value, flags)
         if st == 0:
-            self.cache.invalidate_attr(ino)  # ctime changed
+            self.cache.invalidate_attr(ino)  # mode/ctime changed
         return st
 
     def listxattr(self, ctx, ino) -> tuple[int, list[bytes]]:
-        return self.meta.listxattr(ctx, ino)
+        st, names = self.meta.listxattr(ctx, ino)
+        if st == 0 and self._acl_enabled():
+            st2, attr = self.getattr(ctx, ino)
+            if st2 == 0:
+                if getattr(attr, "access_acl", 0):
+                    names = list(names) + [b"system.posix_acl_access"]
+                if getattr(attr, "default_acl", 0):
+                    names = list(names) + [b"system.posix_acl_default"]
+        return st, names
 
     def removexattr(self, ctx, ino, name) -> int:
         if self.conf.readonly:
             return _errno.EROFS
-        st = self.meta.removexattr(ctx, ino, name)
+        acl_type = self._ACL_XATTRS.get(bytes(name))
+        if acl_type is not None:
+            from ..meta import acl as _acl
+
+            if not self._acl_enabled():
+                return _errno.ENOTSUP
+            st = self.meta.set_facl(ctx, ino, acl_type, _acl.empty_rule())
+        else:
+            st = self.meta.removexattr(ctx, ino, name)
         if st == 0:
             self.cache.invalidate_attr(ino)
         return st
