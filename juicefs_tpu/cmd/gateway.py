@@ -16,6 +16,8 @@ def add_parser(sub):
     g.add_argument("meta_url")
     g.add_argument("--address", default="127.0.0.1")
     g.add_argument("--port", type=int, default=9000)
+    g.add_argument("--metrics", default="",
+                   help="host:port for the /metrics endpoint (empty disables)")
     g.add_argument("--cache-dir", default="")
     g.add_argument("--cache-size", type=int, default=0)
     g.add_argument("--writeback", action="store_true")
@@ -46,7 +48,7 @@ def _build_fs(args):
     return FileSystem(vfs), vfs, m
 
 
-def _serve_forever(vfs, m, server, what: str, port: int):
+def _serve_forever(vfs, m, server, what: str, port: int, metrics: str = ""):
     stop = threading.Event()
 
     def _stop(signum, frame):
@@ -54,8 +56,16 @@ def _serve_forever(vfs, m, server, what: str, port: int):
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
+    metrics_srv = None
+    if metrics:
+        from ..metric import MetricsServer
+
+        metrics_srv = MetricsServer.from_addr(metrics)
+        print(f"metrics on http://{metrics_srv.host}:{metrics_srv.port}/metrics")
     print(f"{what} listening on port {port}")
     stop.wait()
+    if metrics_srv is not None:
+        metrics_srv.stop()
     server.stop()
     vfs.close()
     m.close_session()
@@ -74,7 +84,8 @@ def run_gateway(args) -> int:
     sk = args.secret_key or os.environ.get("MINIO_ROOT_PASSWORD", "")
     gw = S3Gateway(fs, args.address, args.port, access_key=ak, secret_key=sk)
     port = gw.start()
-    return _serve_forever(vfs, m, gw, "S3 gateway", port)
+    return _serve_forever(vfs, m, gw, "S3 gateway", port,
+                          getattr(args, "metrics", ""))
 
 
 def run_webdav(args) -> int:

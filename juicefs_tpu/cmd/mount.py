@@ -28,6 +28,9 @@ def add_parser(sub):
     p.add_argument("--cache-size", default=0, type=int, help="cache size MiB")
     p.add_argument("--writeback", action="store_true")
     p.add_argument("--max-readahead", type=int, default=8, help="MiB")
+    p.add_argument("--metrics", default="",
+                   help="host:port for the /metrics endpoint (reference "
+                        "exposeMetrics; empty disables, port 0 auto-picks)")
     p.add_argument("--no-bgjobs", action="store_true",
                    help="disable background maintenance on this mount")
     p.set_defaults(func=run)
@@ -67,6 +70,13 @@ def serve(args) -> int:
     if not args.no_bgjobs and not args.readonly:
         bg = BackgroundJobs(m, store)
         bg.start()
+    metrics_srv = None
+    if getattr(args, "metrics", ""):
+        from ..metric import MetricsServer
+
+        metrics_srv = MetricsServer.from_addr(args.metrics)
+        logger.info("metrics on http://%s:%d/metrics",
+                    metrics_srv.host, metrics_srv.port)
     srv = Server(vfs, args.mountpoint, fsname=f"juicefs-tpu:{fmt.name}",
                  allow_other=args.allow_other)
     srv.mount()
@@ -80,6 +90,8 @@ def serve(args) -> int:
     try:
         srv.serve()
     finally:
+        if metrics_srv is not None:
+            metrics_srv.stop()
         if bg is not None:
             bg.stop()
         vfs.close()

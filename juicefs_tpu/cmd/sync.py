@@ -3,14 +3,26 @@
 Producer/consumer layout mirroring the reference: both sides stream sorted
 listings, an ordered-merge diff decides what to copy/delete (sync.go:777),
 a worker pool moves the objects (worker :616), include/exclude rules filter
-keys (:881-1076), and --check-new/--check-all byte-compare contents
-(doCheckSum :232 — here via JTH-256 digests instead of raw byte compare).
+keys (:881-1076), and --check-new/--check-all content-compare (doCheckSum
+:232 — here a streaming ranged compare, constant memory).
+
+Large objects are partitioned into ranged GET + multipart-upload parts
+(reference copyData sync.go:440-587) so a 5 GiB object moves through a
+fixed-size buffer instead of resident memory.
+
+Cluster mode (reference pkg/sync/cluster.go:132,237): `--manager-listen`
+turns this process into an HTTP task server feeding the ordered diff to
+any number of `--worker --manager host:port` processes (launched by the
+operator or an external scheduler; the reference bootstraps them via ssh),
+which pull task batches, copy with their own store clients, and push
+stats back.
 """
 
 from __future__ import annotations
 
 import fnmatch
 import json
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -18,6 +30,8 @@ from ..object import create_storage
 from ..utils import get_logger
 
 logger = get_logger("cmd.sync")
+
+CMP_CHUNK = 8 << 20  # streaming-compare window
 
 
 def add_parser(sub):
@@ -39,6 +53,17 @@ def add_parser(sub):
     p.add_argument("--include", action="append", default=[])
     p.add_argument("--exclude", action="append", default=[])
     p.add_argument("--dry", action="store_true")
+    p.add_argument("--big-threshold", type=int, default=32,
+                   help="MiB; objects at least this big copy via ranged "
+                        "multipart parts (reference sync.go:440)")
+    p.add_argument("--part-size", type=int, default=8, help="MiB per part")
+    # cluster mode (reference cluster.go)
+    p.add_argument("--manager-listen", default="",
+                   help="host:port — serve the diff as an HTTP task queue "
+                        "instead of copying locally")
+    p.add_argument("--worker", action="store_true",
+                   help="pull task batches from --manager and execute them")
+    p.add_argument("--manager", default="", help="manager host:port")
     p.set_defaults(func=run)
 
 
@@ -83,26 +108,60 @@ def _diff(src_iter, dst_iter, args):
             s, d = nxt(src_iter), nxt(dst_iter)
 
 
-def _content_equal(src, dst, key: str) -> bool:
-    from .. import native
+def _content_equal(src, dst, key: str, size: int) -> bool:
+    """Streaming ranged compare: constant memory for any object size
+    (replaces whole-object loads; reference doCheckSum streams too)."""
+    if size <= 0:
+        return bytes(src.get(key)) == bytes(dst.get(key))
+    off = 0
+    while off < size:
+        n = min(CMP_CHUNK, size - off)
+        if bytes(src.get(key, off, n)) != bytes(dst.get(key, off, n)):
+            return False
+        off += n
+    return True
 
-    return native.jth256(bytes(src.get(key))) == native.jth256(bytes(dst.get(key)))
+
+def _copy_object(src, dst, obj, args, stats) -> None:
+    """Move one object; big objects go part-by-part through a fixed buffer
+    (reference copyData sync.go:440-587 single-PUT vs UploadPart split)."""
+    threshold = args.big_threshold << 20
+    part_size = max(1 << 20, args.part_size << 20)
+    up = None
+    if obj.size >= threshold:
+        try:
+            up = dst.create_multipart_upload(obj.key)
+        except Exception:
+            up = None
+    if up is None:
+        data = bytes(src.get(obj.key))
+        dst.put(obj.key, data)
+        stats["copied_bytes"] += len(data)
+        return
+    part_size = max(part_size, up.min_part_size)
+    n_parts = (obj.size + part_size - 1) // part_size
+    if n_parts > up.max_count:  # few huge parts beat failing outright
+        part_size = (obj.size + up.max_count - 1) // up.max_count
+        n_parts = (obj.size + part_size - 1) // part_size
+    parts = []
+    try:
+        for i in range(n_parts):
+            off = i * part_size
+            n = min(part_size, obj.size - off)
+            data = bytes(src.get(obj.key, off, n))
+            parts.append(dst.upload_part(obj.key, up.upload_id, i + 1, data))
+            stats["copied_bytes"] += n
+        dst.complete_upload(obj.key, up.upload_id, parts)
+    except BaseException:
+        try:
+            dst.abort_upload(obj.key, up.upload_id)
+        except Exception:
+            pass
+        raise
 
 
-def run(args) -> int:
-    src = create_storage(args.src)
-    dst = create_storage(args.dst)
-    dst.create()
-
-    stats = {"copied": 0, "copied_bytes": 0, "deleted": 0, "checked": 0,
-             "mismatch": 0, "skipped": 0}
-
-    def filtered(store):
-        for obj in store.list_all("", args.start):
-            if args.end and obj.key >= args.end:
-                break
-            if _match(obj.key, args.include, args.exclude):
-                yield obj
+def _make_executor(src, dst, args, stats):
+    """The per-task state machine shared by local and worker modes."""
 
     def do(task):
         op, s, d = task
@@ -111,11 +170,9 @@ def run(args) -> int:
                 if args.dry:
                     stats["copied"] += 1
                     return
-                data = bytes(src.get(s.key))
-                dst.put(s.key, data)
+                _copy_object(src, dst, s, args, stats)
                 stats["copied"] += 1
-                stats["copied_bytes"] += len(data)
-                if args.check_new and not _content_equal(src, dst, s.key):
+                if args.check_new and not _content_equal(src, dst, s.key, s.size):
                     stats["mismatch"] += 1
                     logger.error("verify failed after copy: %s", s.key)
                 if args.delete_src:
@@ -131,16 +188,205 @@ def run(args) -> int:
                 stats["deleted"] += 1
             elif op == "check":
                 stats["checked"] += 1
-                if not _content_equal(src, dst, s.key):
+                if not _content_equal(src, dst, s.key, s.size):
                     stats["mismatch"] += 1
                     logger.error("content mismatch: %s", s.key)
         except Exception as e:
             logger.error("%s %s: %s", op, (s or d).key, e)
             stats["skipped"] += 1
 
+    return do
+
+
+def _new_stats() -> dict:
+    return {"copied": 0, "copied_bytes": 0, "deleted": 0, "checked": 0,
+            "mismatch": 0, "skipped": 0}
+
+
+def run(args) -> int:
+    if args.worker:
+        return run_worker(args)
+
+    src = create_storage(args.src)
+    dst = create_storage(args.dst)
+    dst.create()
+
+    def filtered(store):
+        for obj in store.list_all("", args.start):
+            if args.end and obj.key >= args.end:
+                break
+            if _match(obj.key, args.include, args.exclude):
+                yield obj
+
+    tasks = _diff(filtered(src), filtered(dst), args)
+    if args.manager_listen:
+        return run_manager(args, tasks)
+
+    stats = _new_stats()
+    do = _make_executor(src, dst, args, stats)
     t0 = time.perf_counter()
     with ThreadPoolExecutor(max_workers=args.threads) as pool:
-        list(pool.map(do, _diff(filtered(src), filtered(dst), args)))
+        list(pool.map(do, tasks))
     stats["seconds"] = round(time.perf_counter() - t0, 3)
+    print(json.dumps(stats))
+    return 1 if stats["mismatch"] else 0
+
+
+# -- cluster mode ----------------------------------------------------------
+# Wire protocol (JSON over HTTP, reference gob-over-HTTP cluster.go):
+#   POST /fetch {"n": N}   -> {"tasks": [[op, obj|null, obj|null], ...],
+#                              "done": bool}   (obj = [key, size, mtime])
+#   POST /stats {<stats>}  -> {}
+
+_BATCH = 256
+
+
+def _obj_wire(o):
+    return None if o is None else [o.key, o.size, o.mtime]
+
+
+def _obj_unwire(v):
+    from ..object.interface import Obj
+
+    return None if v is None else Obj(key=v[0], size=v[1], mtime=v[2])
+
+
+def run_manager(args, tasks) -> int:
+    """Serve the ordered diff as a task queue (reference startManager
+    cluster.go:132); aggregate worker stats.
+
+    Completion integrity: the manager counts every task it hands out and
+    requires the workers' aggregated stats to account for all of them —
+    a worker that dies mid-batch (tasks fetched but never reported) turns
+    into a nonzero exit, never a silent partial sync. A worker that dies
+    without even posting stats is caught by the idle timeout instead of
+    hanging the manager forever.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    it = iter(tasks)
+    lock = threading.Lock()
+    totals = _new_stats()
+    done = threading.Event()
+    state = {"busy": 0, "dispatched": 0, "exhausted": False,
+             "last_activity": time.monotonic()}
+
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(n) or b"{}")
+            with lock:
+                state["last_activity"] = time.monotonic()
+            if self.path == "/fetch":
+                batch = []
+                with lock:
+                    for _ in range(min(int(req.get("n", _BATCH)), _BATCH)):
+                        t = next(it, None)
+                        if t is None:
+                            state["exhausted"] = True
+                            break
+                        batch.append([t[0], _obj_wire(t[1]), _obj_wire(t[2])])
+                    state["dispatched"] += len(batch)
+                self._json({"tasks": batch, "done": not batch})
+            elif self.path == "/stats":
+                with lock:
+                    for k, v in req.items():
+                        if k in totals:
+                            totals[k] += v
+                    state["busy"] -= 1
+                    if state["busy"] <= 0:
+                        done.set()
+                self._json({})
+            elif self.path == "/register":
+                with lock:
+                    state["busy"] += 1
+                self._json({})
+            else:
+                self.send_error(404)
+
+        def log_message(self, *a):
+            pass
+
+    host, _, port = args.manager_listen.rpartition(":")
+    httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port or 0)), Handler)
+    addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    print(json.dumps({"manager": addr,
+                      "worker_cmd": f"sync {args.src} {args.dst} --worker "
+                                    f"--manager {addr}"}), flush=True)
+    idle_limit = 300.0
+    timed_out = False
+    while not done.wait(timeout=5.0):
+        with lock:
+            started = state["busy"] > 0 or state["dispatched"] > 0
+            idle = time.monotonic() - state["last_activity"]
+        if started and idle > idle_limit:
+            logger.error("no worker activity for %.0fs; giving up", idle)
+            timed_out = True
+            break
+    httpd.shutdown()
+    httpd.server_close()
+    # every dispatched task must be accounted for in worker stats
+    # (copy may add a delete for --delete-src, so count conservatively)
+    accounted = (totals["copied"] + totals["checked"] + totals["skipped"]
+                 + totals["deleted"])
+    incomplete = (timed_out or not state["exhausted"]
+                  or accounted < state["dispatched"])
+    if incomplete and not timed_out:
+        logger.error(
+            "workers accounted for %d of %d dispatched tasks — partial sync",
+            accounted, state["dispatched"],
+        )
+    totals["dispatched"] = state["dispatched"]
+    print(json.dumps(totals))
+    return 1 if (totals["mismatch"] or incomplete) else 0
+
+
+def run_worker(args) -> int:
+    """Pull task batches from the manager and execute them
+    (reference cluster.go:340 fetchJobs / :90 sendStats)."""
+    import urllib.request
+
+    if not args.manager:
+        logger.error("--worker requires --manager host:port")
+        return 2
+    base = args.manager if "://" in args.manager else f"http://{args.manager}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    src = create_storage(args.src)
+    dst = create_storage(args.dst)
+    stats = _new_stats()
+    do = _make_executor(src, dst, args, stats)
+    post("/register", {})
+    try:
+        with ThreadPoolExecutor(max_workers=args.threads) as pool:
+            while True:
+                out = post("/fetch", {"n": _BATCH})
+                tasks = [
+                    (t[0], _obj_unwire(t[1]), _obj_unwire(t[2]))
+                    for t in out.get("tasks", [])
+                ]
+                if tasks:
+                    list(pool.map(do, tasks))
+                if out.get("done"):
+                    break
+    finally:
+        post("/stats", stats)
     print(json.dumps(stats))
     return 1 if stats["mismatch"] else 0

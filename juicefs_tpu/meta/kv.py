@@ -105,11 +105,14 @@ class KVMeta(BaseMeta):
         self.client = client
         self._nlocal = threading.local()  # deferred notification buffer
         self._qcache: tuple[set[int], float] | None = None  # quota-roots hint
-        # interned ACL rules by id (reference pkg/acl/cache.go). Only
-        # COMMITTED rows enter this map (reads in _load_acl / post-commit),
-        # never allocations from an open transaction — a conflict-aborted
-        # txn must not leave phantom ids behind.
+        # interned ACL rules (reference pkg/acl/cache.go): id -> rule and
+        # the reverse encode -> id used as the insert-dedup fast path. Only
+        # COMMITTED rows enter these maps (_load_acl reads of committed
+        # ids, or _acl_publish after a successful txn) — never allocations
+        # from an open transaction, so a conflict-aborted txn can never
+        # leave phantom ids that would later alias a different rule.
         self._acl_cache: dict[int, "acl_mod.Rule"] = {}
+        self._acl_rev: dict[bytes, int] = {}
 
     def name(self) -> str:
         return self.client.name
@@ -446,7 +449,10 @@ class KVMeta(BaseMeta):
         return 0, attr
 
     def do_setattr(self, ctx: Context, ino: int, flags: int, new: Attr) -> tuple[int, Attr]:
+        interned: list = []  # chmod-derived ACL internings (post-commit)
+
         def fn(tx: KVTxn):
+            interned.clear()
             attr = self._get_attr(tx, ino)
             if attr is None:
                 return errno.ENOENT, Attr()
@@ -469,6 +475,7 @@ class KVMeta(BaseMeta):
                         rule = _rep(rule)
                         rule.set_mode(mode)
                         attr.access_acl = self._insert_acl(tx, rule)
+                        interned.append((attr.access_acl, rule))
                         mode = (mode & 0o7000) | rule.get_mode()
                 attr.mode = mode
                 changed = True
@@ -498,7 +505,11 @@ class KVMeta(BaseMeta):
                 self._set_attr(tx, ino, attr)
             return 0, attr
 
-        return self._etxn(fn)
+        out = self._etxn(fn)
+        if out[0] == 0:
+            for aid, r in interned:
+                self._acl_publish(aid, r)
+        return out
 
     # ---- namespace -------------------------------------------------------
     def do_lookup(self, parent: int, name: bytes) -> tuple[int, int, Attr]:
@@ -521,8 +532,10 @@ class KVMeta(BaseMeta):
 
     def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path) -> tuple[int, int, Attr]:
         ino = self.new_inode()
+        interned: list = []  # inherited-ACL internings, published post-commit
 
         def fn(tx: KVTxn):
+            interned.clear()
             pattr = self._get_attr(tx, parent)
             if pattr is None:
                 return errno.ENOENT, 0, Attr()
@@ -566,6 +579,7 @@ class KVMeta(BaseMeta):
                 else:
                     crule = drule.child_access_acl(req_mode)
                     child_access = self._insert_acl(tx, crule)
+                    interned.append((child_access, crule))
                     eff_mode = (req_mode & 0o7000) | crule.get_mode()
             else:
                 eff_mode = req_mode & ~cumask
@@ -596,7 +610,11 @@ class KVMeta(BaseMeta):
             )
             return 0, ino, attr
 
-        return self._etxn(fn)
+        out = self._etxn(fn)
+        if out[0] == 0:
+            for aid, r in interned:
+                self._acl_publish(aid, r)
+        return out
 
     def _trash_entry(self, tx: KVTxn, parent: int, name: bytes, ino: int, typ: int) -> None:
         """Move a doomed entry under the hourly trash dir
@@ -1283,7 +1301,15 @@ class KVMeta(BaseMeta):
                 return None
             rule = acl_mod.Rule.decode(raw)
             self._acl_cache[aid] = rule
+            self._acl_rev[bytes(raw)] = aid
         return rule
+
+    def _acl_publish(self, aid: int, rule: Optional["acl_mod.Rule"]) -> None:
+        """Record a rule interning AFTER its transaction committed, making
+        it eligible as an _insert_acl fast-path hit."""
+        if aid != acl_mod.ACL_NONE and rule is not None:
+            self._acl_cache.setdefault(aid, rule)
+            self._acl_rev.setdefault(rule.encode(), aid)
 
     def _insert_acl(self, tx: KVTxn, rule: Optional["acl_mod.Rule"]) -> int:
         """Intern a rule, deduplicating against all persisted rules
@@ -1300,6 +1326,9 @@ class KVMeta(BaseMeta):
         if rule is None or rule.is_empty():
             return acl_mod.ACL_NONE
         enc = rule.encode()
+        aid = self._acl_rev.get(enc)  # committed-rule fast path
+        if aid is not None:
+            return aid
         for k, v in tx.scan(b"R", next_key(b"R")):
             if len(k) == 5 and bytes(v) == enc:
                 return int.from_bytes(k[1:5], "big")
@@ -1321,7 +1350,10 @@ class KVMeta(BaseMeta):
         """Port of reference tkv.go:3594 doSetFacl: ACL<->mode interplay."""
         from dataclasses import replace as _rep
 
+        interned: list = []  # (aid, rule) published after commit
+
         def fn(tx: KVTxn):
+            interned.clear()  # conflict retry reruns the closure
             attr = self._get_attr(tx, ino)
             if attr is None:
                 return errno.ENOENT
@@ -1351,6 +1383,7 @@ class KVMeta(BaseMeta):
                 r = _rep(rule)
                 r.inherit_perms(attr.mode)
                 new_id = self._insert_acl(tx, r)
+                interned.append((new_id, r))
                 if acl_type == acl_mod.TYPE_ACCESS:
                     attr.mode = (attr.mode & 0o7000) | r.get_mode()
             if acl_type == acl_mod.TYPE_ACCESS:
@@ -1362,7 +1395,11 @@ class KVMeta(BaseMeta):
                 self._set_attr(tx, ino, attr)
             return 0
 
-        return self.client.txn(fn)
+        st = self.client.txn(fn)
+        if st == 0:
+            for aid, r in interned:
+                self._acl_publish(aid, r)
+        return st
 
     def do_get_facl(self, ino: int, acl_type: int) -> tuple[int, Optional["acl_mod.Rule"]]:
         """reference tkv.go:3656 doGetFacl; ENODATA when no such ACL."""

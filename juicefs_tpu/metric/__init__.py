@@ -208,3 +208,85 @@ _global = Registry()
 
 def global_registry() -> Registry:
     return _global
+
+
+def register_process_metrics(reg: Optional[Registry] = None) -> None:
+    """CPU / memory / uptime gauges (reference pkg/metric/metrics.go:34-56)."""
+    import os
+    import resource
+    import time as _time
+
+    reg = reg or global_registry()
+    t0 = _time.time()
+    reg.gauge("juicefs_uptime", "Seconds since process start").set_function(
+        lambda: _time.time() - t0
+    )
+    reg.gauge("juicefs_cpu_usage", "Accumulated process CPU seconds").set_function(
+        lambda: (lambda r: r.ru_utime + r.ru_stime)(
+            resource.getrusage(resource.RUSAGE_SELF)
+        )
+    )
+    reg.gauge("juicefs_memory", "Peak RSS in bytes").set_function(
+        lambda: resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    )
+    reg.gauge("juicefs_pid", "Process id").set_function(os.getpid)
+
+
+class MetricsServer:
+    """HTTP /metrics endpoint for a registry
+    (reference exposeMetrics cmd/mount.go:84: pull-based Prometheus).
+
+    Binds host:port (port 0 picks a free one — exposed via .port) and
+    serves the text exposition format from a daemon thread.
+    """
+
+    @classmethod
+    def from_addr(cls, addr: str, registry: Optional[Registry] = None,
+                  with_process_metrics: bool = True) -> "MetricsServer":
+        """Parse 'host:port' / ':port' / 'port', validate, register the
+        process gauges, and start serving (shared by mount/gateway)."""
+        host, _, port = addr.rpartition(":")
+        if not port.isdigit():
+            raise ValueError(
+                f"--metrics expects host:port or port, got {addr!r}"
+            )
+        if with_process_metrics:
+            register_process_metrics(registry)
+        return cls(registry, host=host or "127.0.0.1", port=int(port)).start()
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        reg = registry or global_registry()
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = reg.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics", daemon=True
+        )
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

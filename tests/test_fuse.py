@@ -281,3 +281,33 @@ def test_posix_acl_through_kernel(acl_mnt):
     os.removexattr(p, "system.posix_acl_access")
     with pytest.raises(OSError):
         os.getxattr(p, "system.posix_acl_access")
+
+
+def test_metrics_endpoint_during_mount(mnt):
+    """/metrics over HTTP while the volume is mounted shows FUSE op
+    histograms (VERDICT r2 #10; reference exposeMetrics cmd/mount.go:84)."""
+    import urllib.request
+
+    from juicefs_tpu.metric import MetricsServer, global_registry
+
+    srv = MetricsServer(global_registry()).start()
+    try:
+        p = os.path.join(mnt, "metered.txt")
+        with open(p, "wb") as f:
+            f.write(b"count me")
+        with open(p, "rb") as f:
+            f.read()
+        body = urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/metrics", timeout=5
+        ).read().decode()
+        assert "juicefs_fuse_ops_durations_histogram_seconds" in body
+        assert 'method="write"' in body and 'method="read"' in body
+        assert "_bucket" in body and "_count" in body
+        # 404 for anything else
+        try:
+            urllib.request.urlopen(f"http://{srv.host}:{srv.port}/nope", timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()

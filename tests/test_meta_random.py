@@ -1,0 +1,218 @@
+"""Randomized cross-engine metadata testing (VERDICT r2 #9; reference
+pkg/meta/random_test.go 1,753 LoC + .github/scripts/hypo/fs.py stateful
+model): one deterministic random op sequence is replayed against every
+meta engine (memkv, sqlite3, redis) and each step's errno plus the final
+tree state must agree across engines — any divergence is an engine bug.
+"""
+
+import errno
+import os
+import random
+
+import pytest
+
+from juicefs_tpu.meta import Format, new_client, ROOT_INODE
+from juicefs_tpu.meta.context import Context
+from juicefs_tpu.meta.types import (
+    Attr,
+    SET_ATTR_MODE,
+    TYPE_DIRECTORY,
+    TYPE_FILE,
+    TYPE_SYMLINK,
+)
+
+CTX = Context(uid=0, gid=0, pid=1)
+NAMES = [f"n{i}".encode() for i in range(8)]  # small namespace -> collisions
+N_OPS = 1200
+
+
+class Driver:
+    """Applies generated ops to one engine; tracks known dirs by the same
+    indices on every engine (kept aligned because errnos must match)."""
+
+    def __init__(self, meta):
+        self.m = meta
+        self.dirs = [ROOT_INODE]  # index 0 = root
+
+    def _resolve(self, dir_idx: int) -> int:
+        return self.dirs[dir_idx % len(self.dirs)]
+
+    def apply(self, op) -> tuple:
+        kind = op[0]
+        m = self.m
+        if kind == "mkdir":
+            _, dir_idx, name = op
+            st, ino, attr = m.mkdir(CTX, self._resolve(dir_idx), name, 0o755)
+            if st == 0:
+                self.dirs.append(ino)
+            return (st,)
+        if kind == "create":
+            _, dir_idx, name, mode = op
+            st, ino, attr = m.create(CTX, self._resolve(dir_idx), name, mode)
+            if st == 0:
+                m.close(CTX, ino)
+            return (st, attr.mode if st == 0 else 0)
+        if kind == "symlink":
+            _, dir_idx, name, target = op
+            st, _, _ = m.symlink(CTX, self._resolve(dir_idx), name, target)
+            return (st,)
+        if kind == "unlink":
+            _, dir_idx, name = op
+            return (m.unlink(CTX, self._resolve(dir_idx), name),)
+        if kind == "rmdir":
+            _, dir_idx, name = op
+            st = m.rmdir(CTX, self._resolve(dir_idx), name)
+            return (st,)
+        if kind == "rename":
+            _, di1, n1, di2, n2 = op
+            st, _, _ = m.rename(
+                CTX, self._resolve(di1), n1, self._resolve(di2), n2, 0
+            )
+            return (st,)
+        if kind == "link":
+            _, di1, n1, di2, n2 = op
+            st, ino, _ = m.lookup(CTX, self._resolve(di1), n1)
+            if st != 0:
+                return ("lookup", st)
+            st2, attr = m.link(CTX, ino, self._resolve(di2), n2)
+            return ("link", st2, attr.nlink if st2 == 0 else 0)
+        if kind == "chmod":
+            _, dir_idx, name, mode = op
+            st, ino, _ = m.lookup(CTX, self._resolve(dir_idx), name)
+            if st != 0:
+                return ("lookup", st)
+            st2, attr = m.setattr(CTX, ino, SET_ATTR_MODE, Attr(mode=mode))
+            return ("chmod", st2, attr.mode if st2 == 0 else 0)
+        if kind == "truncate":
+            _, dir_idx, name, length = op
+            st, ino, _ = m.lookup(CTX, self._resolve(dir_idx), name)
+            if st != 0:
+                return ("lookup", st)
+            st2, attr = m.truncate(CTX, ino, length)
+            return ("trunc", st2, attr.length if st2 == 0 else -1)
+        if kind == "xattr":
+            _, dir_idx, name, xname, xval = op
+            st, ino, _ = m.lookup(CTX, self._resolve(dir_idx), name)
+            if st != 0:
+                return ("lookup", st)
+            st2 = m.setxattr(CTX, ino, xname, xval)
+            st3, got = m.getxattr(CTX, ino, xname)
+            return ("xattr", st2, st3, bytes(got) if st3 == 0 else b"")
+        if kind == "lookup":
+            _, dir_idx, name = op
+            st, _, attr = m.lookup(CTX, self._resolve(dir_idx), name)
+            return (st, attr.typ if st == 0 else 0,
+                    attr.mode if st == 0 else 0)
+        if kind == "readdir":
+            _, dir_idx = op
+            st, entries = m.readdir(CTX, self._resolve(dir_idx))
+            names = tuple(sorted(e.name for e in entries))
+            return (st, names)
+        raise AssertionError(kind)
+
+    def tree(self, ino=ROOT_INODE) -> dict:
+        """Canonical logical state: structure + deterministic attr fields."""
+        st, entries = self.m.readdir(CTX, ino, want_attr=True)
+        assert st == 0
+        out = {}
+        for e in entries:
+            if e.name in (b".", b".."):
+                continue
+            a = e.attr
+            node = {
+                "typ": a.typ, "mode": a.mode, "nlink": a.nlink,
+                "length": a.length if a.typ != TYPE_DIRECTORY else None,
+            }
+            if a.typ == TYPE_SYMLINK:
+                st2, target = self.m.readlink(CTX, e.inode)
+                node["target"] = bytes(target)
+            if a.typ == TYPE_DIRECTORY:
+                node["children"] = self.tree(e.inode)
+            st3, xnames = self.m.listxattr(CTX, e.inode)
+            node["xattrs"] = {
+                bytes(x): bytes(self.m.getxattr(CTX, e.inode, x)[1])
+                for x in xnames
+            }
+            out[bytes(e.name)] = node
+        return out
+
+
+def gen_ops(seed: int, n: int) -> list:
+    rng = random.Random(seed)
+    ops = []
+    for _ in range(n):
+        kind = rng.choice(
+            ["mkdir", "create", "create", "symlink", "unlink", "unlink",
+             "rmdir", "rename", "rename", "link", "chmod", "truncate",
+             "xattr", "lookup", "lookup", "readdir"]
+        )
+        di = rng.randrange(16)
+        name = rng.choice(NAMES)
+        if kind == "mkdir":
+            ops.append(("mkdir", di, name))
+        elif kind == "create":
+            ops.append(("create", di, name, rng.choice([0o644, 0o600, 0o755])))
+        elif kind == "symlink":
+            ops.append(("symlink", di, name, b"/t/" + name))
+        elif kind in ("unlink", "rmdir"):
+            ops.append((kind, di, name))
+        elif kind in ("rename", "link"):
+            ops.append((kind, di, name, rng.randrange(16), rng.choice(NAMES)))
+        elif kind == "chmod":
+            ops.append(("chmod", di, name, rng.choice([0o600, 0o640, 0o777])))
+        elif kind == "truncate":
+            ops.append(("truncate", di, name, rng.randrange(0, 1 << 20)))
+        elif kind == "xattr":
+            ops.append(("xattr", di, name, b"user.k%d" % rng.randrange(3),
+                        os.urandom(rng.randrange(1, 16))))
+        elif kind == "lookup":
+            ops.append(("lookup", di, name))
+        elif kind == "readdir":
+            ops.append(("readdir", di))
+    return ops
+
+
+def _engines(tmp_path):
+    engines = [("memkv", new_client("mem://"))]
+    engines.append(
+        ("sqlite3", new_client(f"sqlite3://{tmp_path}/rand.db"))
+    )
+    from juicefs_tpu.meta.redis_server import RedisServer
+
+    srv = RedisServer()
+    port = srv.start()
+    engines.append(("redis", new_client(f"redis://127.0.0.1:{port}/0")))
+    return engines, srv
+
+
+@pytest.mark.parametrize("seed", [7, 1234])
+def test_random_ops_agree_across_engines(tmp_path, seed):
+    engines, srv = _engines(tmp_path)
+    try:
+        drivers = []
+        for name, m in engines:
+            m.init(Format(name=f"rnd", trash_days=0), force=True)
+            m.load()
+            drivers.append((name, Driver(m)))
+
+        ops = gen_ops(seed, N_OPS)
+        for i, op in enumerate(ops):
+            results = [(name, d.apply(op)) for name, d in drivers]
+            first = results[0][1]
+            for name, r in results[1:]:
+                assert r == first, (
+                    f"step {i} {op}: {results[0][0]}={first!r} {name}={r!r}"
+                )
+        # final logical state identical everywhere
+        trees = [(name, d.tree()) for name, d in drivers]
+        for name, t in trees[1:]:
+            assert t == trees[0][1], f"final tree diverged on {name}"
+        # sanity: the sequence actually built something
+        assert trees[0][1], "random sequence produced an empty tree"
+    finally:
+        for _, m in engines:
+            try:
+                m.close()
+            except Exception:
+                pass
+        srv.stop()

@@ -1,0 +1,127 @@
+"""Sync engine: multipart partition of large objects and the manager/
+worker cluster mode (VERDICT r2 #8; reference pkg/sync/sync.go:440-587
+copyData partition, pkg/sync/cluster.go:132,237 manager/worker)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from juicefs_tpu.cmd import main
+
+
+def _fill(root, objs):
+    for rel, data in objs.items():
+        p = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data)
+
+
+def _tree(root):
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            p = os.path.join(dirpath, fn)
+            rel = os.path.relpath(p, root)
+            if rel.startswith(".uploads"):
+                continue
+            with open(p, "rb") as f:
+                out[rel] = f.read()
+    return out
+
+
+def test_multipart_copy_uses_ranged_parts(tmp_path, capsys):
+    """An object over the threshold moves via ranged part GETs, never a
+    whole-object load (constant memory per worker)."""
+    from types import SimpleNamespace
+
+    from juicefs_tpu.cmd.sync import _copy_object
+    from juicefs_tpu.object import create_storage
+
+    src_root, dst_root = tmp_path / "src", tmp_path / "dst"
+    src_root.mkdir(), dst_root.mkdir()
+    big = os.urandom(5 << 20)
+    _fill(str(src_root), {"big.bin": big})
+
+    src = create_storage(f"file://{src_root}")
+    dst = create_storage(f"file://{dst_root}")
+
+    max_get = [0]
+    real_get = src.get
+
+    def spy_get(key, off=0, limit=-1):
+        data = real_get(key, off, limit)
+        max_get[0] = max(max_get[0], len(bytes(data)))
+        return data
+
+    src.get = spy_get
+    args = SimpleNamespace(big_threshold=1, part_size=1)  # 1 MiB / 1 MiB
+    stats = {"copied_bytes": 0}
+    obj = next(o for o in src.list_all("") if o.key == "big.bin")
+    _copy_object(src, dst, obj, args, stats)
+    assert (dst_root / "big.bin").read_bytes() == big
+    assert stats["copied_bytes"] == len(big)
+    assert max_get[0] <= 1 << 20  # never loaded more than one part
+
+
+def test_sync_big_threshold_end_to_end(tmp_path, capsys):
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    src.mkdir()
+    blob = os.urandom(3 << 20)
+    _fill(str(src), {"a/big.bin": blob, "small.txt": b"tiny"})
+    rc = main(["sync", f"file://{src}", f"file://{dst}",
+               "--big-threshold", "1", "--part-size", "1", "--check-new"])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["copied"] == 2 and stats["mismatch"] == 0
+    assert _tree(str(dst)) == {"a/big.bin": blob, "small.txt": b"tiny"}
+
+
+def test_cluster_mode_two_workers(tmp_path):
+    """Manager serves the diff over HTTP; two separate worker PROCESSES
+    drain it and the union of their work covers the keyspace."""
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    src.mkdir()
+    # enough objects for several fetch batches so both workers get work
+    objs = {f"d{i % 4}/f{i:03d}": os.urandom(256 + i) for i in range(600)}
+    _fill(str(src), objs)
+
+    mgr = subprocess.Popen(
+        [sys.executable, "-m", "juicefs_tpu.cmd", "sync",
+         f"file://{src}", f"file://{dst}", "--manager-listen", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, text=True, cwd="/root/repo",
+    )
+    try:
+        hello = json.loads(mgr.stdout.readline())
+        addr = hello["manager"]
+
+        workers = [
+            subprocess.Popen(
+                [sys.executable, "-m", "juicefs_tpu.cmd", "sync",
+                 f"file://{src}", f"file://{dst}",
+                 "--worker", "--manager", addr, "--threads", "4"],
+                stdout=subprocess.PIPE, text=True, cwd="/root/repo",
+            )
+            for _ in range(2)
+        ]
+        wstats = []
+        for w in workers:
+            out, _ = w.communicate(timeout=60)
+            assert w.returncode == 0, out
+            wstats.append(json.loads(out.strip().splitlines()[-1]))
+        out, _ = mgr.communicate(timeout=30)
+        totals = json.loads(out.strip().splitlines()[-1])
+    finally:
+        mgr.kill()
+
+    assert _tree(str(dst)) == objs  # full keyspace copied exactly once
+    assert totals["copied"] == len(objs)  # stats aggregated from workers
+    # every copy came through a worker, none duplicated (a worker that
+    # starts after the queue drains may legitimately get zero tasks)
+    assert sum(s["copied"] for s in wstats) == len(objs)
+    assert all(s["mismatch"] == 0 and s["skipped"] == 0 for s in wstats)
