@@ -24,6 +24,8 @@ def add_parser(sub):
     q.add_argument("path", nargs="?", default="")
     q.add_argument("--space", type=float, default=0, help="space limit GiB (0=unlimited)")
     q.add_argument("--inodes", type=int, default=0, help="inode limit (0=unlimited)")
+    q.add_argument("--repair", action="store_true",
+                   help="with 'check': write recomputed usage back")
     q.set_defaults(func=run_quota)
 
     m = sub.add_parser("mdtest", help="metadata micro-benchmark")
@@ -62,7 +64,27 @@ def run_quota(args) -> int:
             print(f"set quota: errno {st}")
             return 1
         print(f"quota set on {args.path}")
-    elif args.action in ("get", "check"):
+    elif args.action == "check":
+        # recompute true usage; --repair heals hint-window drift. EAGAIN =
+        # usage changed during the walk; retry a few times.
+        import errno as _errno
+
+        for _ in range(5):
+            st, stored, actual = m.check_dir_quota(BACKGROUND, ino, args.repair)
+            if st != _errno.EAGAIN:
+                break
+        if st:
+            print(f"check quota: errno {st}")
+            return 1
+        drifted = stored != actual
+        print(json.dumps({
+            "path": args.path,
+            "stored_space": stored[0], "stored_inodes": stored[1],
+            "actual_space": actual[0], "actual_inodes": actual[1],
+            "drifted": drifted, "repaired": bool(args.repair and drifted),
+        }))
+        return 1 if (drifted and not args.repair) else 0
+    elif args.action == "get":
         rec = m.get_dir_quota(ino)
         if rec is None:
             print(f"no quota on {args.path}")

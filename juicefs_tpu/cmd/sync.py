@@ -169,15 +169,16 @@ def _make_executor(src, dst, args, stats):
             if op == "copy":
                 if args.dry:
                     stats["copied"] += 1
-                    return
-                _copy_object(src, dst, s, args, stats)
-                stats["copied"] += 1
-                if args.check_new and not _content_equal(src, dst, s.key, s.size):
-                    stats["mismatch"] += 1
-                    logger.error("verify failed after copy: %s", s.key)
-                if args.delete_src:
-                    src.delete(s.key)
-                    stats["deleted"] += 1
+                else:
+                    _copy_object(src, dst, s, args, stats)
+                    stats["copied"] += 1
+                    if args.check_new and not _content_equal(
+                            src, dst, s.key, s.size):
+                        stats["mismatch"] += 1
+                        logger.error("verify failed after copy: %s", s.key)
+                    if args.delete_src:
+                        src.delete(s.key)
+                        stats["deleted"] += 1
             elif op == "del-dst":
                 if not args.dry:
                     dst.delete(d.key)
@@ -191,16 +192,22 @@ def _make_executor(src, dst, args, stats):
                 if not _content_equal(src, dst, s.key, s.size):
                     stats["mismatch"] += 1
                     logger.error("content mismatch: %s", s.key)
+            # counted only on full execution: a BaseException (interrupt)
+            # skips this, so the manager sees the task as unaccounted
+            stats["tasks_done"] += 1
         except Exception as e:
             logger.error("%s %s: %s", op, (s or d).key, e)
             stats["skipped"] += 1
+            stats["tasks_done"] += 1
 
     return do
 
 
 def _new_stats() -> dict:
+    # tasks_done counts tasks that ran to completion (including skips):
+    # the manager's completion check compares it against dispatched count
     return {"copied": 0, "copied_bytes": 0, "deleted": 0, "checked": 0,
-            "mismatch": 0, "skipped": 0}
+            "mismatch": 0, "skipped": 0, "tasks_done": 0}
 
 
 def run(args) -> int:
@@ -215,6 +222,8 @@ def run(args) -> int:
         for obj in store.list_all("", args.start):
             if args.end and obj.key >= args.end:
                 break
+            if obj.is_dir:
+                continue  # folder markers are not copyable objects
             if _match(obj.key, args.include, args.exclude):
                 yield obj
 
@@ -309,6 +318,8 @@ def run_manager(args, tasks) -> int:
                 with lock:
                     state["busy"] += 1
                 self._json({})
+            elif self.path == "/ping":
+                self._json({})  # worker heartbeat (long in-batch copies)
             else:
                 self.send_error(404)
 
@@ -320,8 +331,18 @@ def run_manager(args, tasks) -> int:
     addr = f"{httpd.server_address[0]}:{httpd.server_address[1]}"
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
+    # the hint must carry every execution flag: a worker missing --dry
+    # would really copy, missing --delete-src would skip deletions, etc.
+    flags = []
+    for f in ("dry", "check_new", "check_all", "delete_src", "delete_dst",
+              "update", "force_update"):
+        if getattr(args, f):
+            flags.append("--" + f.replace("_", "-"))
+    flags += ["--big-threshold", str(args.big_threshold),
+              "--part-size", str(args.part_size)]
     print(json.dumps({"manager": addr,
-                      "worker_cmd": f"sync {args.src} {args.dst} --worker "
+                      "worker_cmd": f"sync {args.src} {args.dst} "
+                                    f"{' '.join(flags)} --worker "
                                     f"--manager {addr}"}), flush=True)
     idle_limit = 300.0
     timed_out = False
@@ -335,16 +356,14 @@ def run_manager(args, tasks) -> int:
             break
     httpd.shutdown()
     httpd.server_close()
-    # every dispatched task must be accounted for in worker stats
-    # (copy may add a delete for --delete-src, so count conservatively)
-    accounted = (totals["copied"] + totals["checked"] + totals["skipped"]
-                 + totals["deleted"])
+    # every dispatched task must come back as a completed task: a worker
+    # killed mid-batch reports fewer tasks_done than it fetched
     incomplete = (timed_out or not state["exhausted"]
-                  or accounted < state["dispatched"])
+                  or totals["tasks_done"] < state["dispatched"])
     if incomplete and not timed_out:
         logger.error(
-            "workers accounted for %d of %d dispatched tasks — partial sync",
-            accounted, state["dispatched"],
+            "workers completed %d of %d dispatched tasks — partial sync",
+            totals["tasks_done"], state["dispatched"],
         )
     totals["dispatched"] = state["dispatched"]
     print(json.dumps(totals))
@@ -374,6 +393,19 @@ def run_worker(args) -> int:
     stats = _new_stats()
     do = _make_executor(src, dst, args, stats)
     post("/register", {})
+    # heartbeat: a batch of large multipart copies can run far longer than
+    # the manager's idle timeout between /fetch posts
+    stop_ping = threading.Event()
+
+    def ping():
+        while not stop_ping.wait(30.0):
+            try:
+                post("/ping", {})
+            except Exception:
+                pass
+
+    pinger = threading.Thread(target=ping, daemon=True)
+    pinger.start()
     try:
         with ThreadPoolExecutor(max_workers=args.threads) as pool:
             while True:
@@ -387,6 +419,7 @@ def run_worker(args) -> int:
                 if out.get("done"):
                     break
     finally:
+        stop_ping.set()
         post("/stats", stats)
     print(json.dumps(stats))
     return 1 if stats["mismatch"] else 0

@@ -102,11 +102,21 @@ class S3Gateway(HTTPAdapter):
                     self._body()
                     self._error(403, "RequestTimeTooSkewed")
                     return False
-                # the signed payload hash must match the actual body
+                # Payload integrity (ADVICE r2): standard AWS SDK/CLI
+                # clients commonly sign UNSIGNED-PAYLOAD — accept it (the
+                # signature still covers that literal), verify the hash
+                # when one is given, and reject the streaming scheme
+                # explicitly instead of failing with a hash mismatch.
                 body = self._body()
-                if headers.get("x-amz-content-sha256", "") != _hashlib.sha256(
-                    body
-                ).hexdigest():
+                content_sha = headers.get("x-amz-content-sha256", "")
+                if content_sha.startswith("STREAMING-"):
+                    self._error(
+                        501, "NotImplemented",
+                        "streaming chunked payloads are not supported",
+                    )
+                    return False
+                if content_sha != "UNSIGNED-PAYLOAD" and content_sha != \
+                        _hashlib.sha256(body).hexdigest():
                     self._error(400, "XAmzContentSHA256Mismatch")
                     return False
                 u = urllib.parse.urlsplit(self.path)
@@ -439,8 +449,9 @@ class S3Gateway(HTTPAdapter):
                 if prefix and not dkey.startswith(prefix[: len(dkey)]):
                     continue
                 if dkey.startswith(prefix) or prefix.startswith(dkey):
-                    if dkey.startswith(prefix):
-                        out.append((dkey, e.attr))
+                    # directories are not objects: real S3 lists only keys
+                    # (ADVICE r2 — emitting "dir/" entries forced drivers
+                    # to guess which trailing-slash keys were markers)
                     self._walk(bucket, dkey, out, prefix)
             elif key.startswith(prefix):
                 out.append((key, e.attr))

@@ -1428,10 +1428,15 @@ class KVMeta(BaseMeta):
     def _quota_roots_hint(self) -> set[int]:
         """Cached set of quota-root inodes (reference quota.go keeps loaded
         quotas in memory, refreshed periodically). The hint only prunes the
-        ancestor walk — actual records are still read inside the txn — so
-        the ONLY staleness effect is a new quota taking up to TTL seconds
-        to be seen by other clients, same as the reference's flush cadence.
-        Without it every dirstat update walks the parent chain: O(depth)
+        ancestor walk — actual records are still read inside the txn.
+
+        Staleness consequence (ADVICE r2): a quota created by ANOTHER
+        client is invisible to this client's hint for up to TTL seconds,
+        and any write committed in that window skips _quota_update for it
+        — the stored used_space/used_inodes then stay drifted until
+        `quota check --repair` (check_dir_quota) recomputes them. The
+        reference has the same window and the same repair tool. Without
+        the hint every dirstat update walks the parent chain: O(depth)
         network round trips per op on a networked engine."""
         cached = self._qcache
         now = time.monotonic()
@@ -1546,6 +1551,47 @@ class KVMeta(BaseMeta):
         if raw is None:
             return None
         return self._QFMT.unpack(raw)
+
+    def check_dir_quota(self, ctx: Context, ino: int, repair: bool = False):
+        """Recompute a quota root's true usage from a tree walk and compare
+        to the stored counters; with repair=True write the recomputed
+        values back (reference `juicefs quota check` cmd/quota.go).
+
+        This is the recovery path for the hint-window drift documented at
+        _quota_roots_hint: writes committed before another client observes
+        a brand-new quota are missed permanently until repaired here.
+        Returns (errno, stored(space,inodes), actual(space,inodes)).
+        """
+        rec = self.get_dir_quota(ino)
+        if rec is None:
+            return errno.ENOENT, (0, 0), (0, 0)
+        sl, il, us, ui = rec
+        st, summ = self.summary(ctx, ino)
+        if st:
+            return st, (us, ui), (0, 0)
+        actual_space = max(0, summ.size - 4096)
+        actual_inodes = summ.files + summ.dirs - 1
+        if repair and (us, ui) != (actual_space, actual_inodes):
+            def fn(tx: KVTxn):
+                raw = tx.get(self._dirquota_key(ino))
+                if raw is None:
+                    return errno.ENOENT
+                cur = self._QFMT.unpack(raw)
+                if cur[2:] != (us, ui):
+                    # usage moved while the tree walk ran: blindly writing
+                    # the stale walk result would erase that activity —
+                    # surface EAGAIN so the caller re-runs the check
+                    return errno.EAGAIN
+                tx.set(
+                    self._dirquota_key(ino),
+                    self._QFMT.pack(cur[0], cur[1], actual_space, actual_inodes),
+                )
+                return 0
+
+            st = self._etxn(fn)
+            if st:
+                return st, (us, ui), (actual_space, actual_inodes)
+        return 0, (us, ui), (actual_space, actual_inodes)
 
     def del_dir_quota(self, ino: int) -> int:
         def fn(tx: KVTxn):

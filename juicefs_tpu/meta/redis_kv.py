@@ -147,7 +147,15 @@ class _RedisTxn(KVTxn):
         self._writes[key] = None
 
     def scan(self, begin, end, keys_only=False, limit=-1):
-        # server range (no WATCH on ranges: per-key optimism like redis.go)
+        # Server range WITHOUT conflict detection: neither the scanned keys
+        # nor the !idx index are WATCHed, so EXEC can commit a decision
+        # based on a stale range read (ADVICE r2). This is safe under the
+        # meta schema's invariant that every namespace mutation also writes
+        # the parent directory's attr key (A{ino}I): range-dependent
+        # decisions (e.g. rmdir's emptiness scan) always also GET+WATCH
+        # that attr key in the same closure, so a competing create/unlink
+        # invalidates the txn through it. Keep that invariant when adding
+        # ops whose correctness depends on a scan.
         names = self._client._range(self._conn, begin, end)
         merged: dict[bytes, Optional[bytes]] = {}
         if not keys_only and names:

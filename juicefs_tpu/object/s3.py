@@ -297,12 +297,17 @@ class S3Storage(ObjectStorage):
                 ns = root.tag.split("}")[0] + "}"
             for c in root.findall(f"{ns}Contents"):
                 k = c.findtext(f"{ns}Key") or ""
-                if k.endswith("/"):
-                    continue  # folder markers (gateway dirs): not objects
+                # every key is returned, including trailing-slash folder
+                # markers (ADVICE r2; reference pkg/object/s3.go does the
+                # same — our gateway no longer lists directories at all)
                 if self.prefix:
                     if not k.startswith(self.prefix):
                         continue
                     k = k[len(self.prefix):]
+                if not k:
+                    # the marker object equal to the configured prefix
+                    # itself strips to an empty key: nothing to address
+                    continue
                 size = int(c.findtext(f"{ns}Size") or 0)
                 mtime = 0.0
                 lm = c.findtext(f"{ns}LastModified")
@@ -313,7 +318,8 @@ class S3Storage(ObjectStorage):
                         ).timestamp()
                     except ValueError:
                         pass
-                yield Obj(key=k, size=size, mtime=mtime)
+                yield Obj(key=k, size=size, mtime=mtime,
+                          is_dir=k.endswith("/"))
             trunc = (root.findtext(f"{ns}IsTruncated") or "").lower() == "true"
             token = root.findtext(f"{ns}NextContinuationToken") or ""
             if not trunc or not token:

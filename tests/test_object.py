@@ -288,3 +288,53 @@ def test_file_store_atomic_and_clean(tmp_path):
     import os
 
     assert not os.path.exists(tmp_path / "atomic" / "a")
+
+
+def test_s3_sigv4_unsigned_payload_interop(tmp_path):
+    """Standard AWS SDK/CLI clients often sign UNSIGNED-PAYLOAD instead of
+    the body hash (ADVICE r2): the gateway must accept it (signature still
+    verified over the literal) and reject the streaming scheme clearly."""
+    import hashlib
+    import http.client
+
+    from juicefs_tpu.object.s3 import SigV4
+
+    gw, v, ep = _make_s3_env(tmp_path)
+    try:
+        create_storage(ep + "/bkt").create()
+        host = ep.split("@", 1)[1].split("/")[0]
+        signer = SigV4("testak", "testsk")
+        conn = http.client.HTTPConnection(host, timeout=10)
+
+        body = b"sdk-style upload"
+        hdrs = signer.sign("PUT", host, "/bkt/u1", {}, "UNSIGNED-PAYLOAD")
+        hdrs["Content-Length"] = str(len(body))
+        conn.request("PUT", "/bkt/u1", body=body, headers=hdrs)
+        r = conn.getresponse()
+        r.read()
+        assert r.status in (200, 201), r.status
+
+        # object actually landed with the body bytes
+        assert bytes(create_storage(ep + "/bkt").get("u1")) == body
+
+        # wrong secret with UNSIGNED-PAYLOAD still rejected
+        bad = SigV4("testak", "WRONG")
+        hdrs = bad.sign("PUT", host, "/bkt/u2", {}, "UNSIGNED-PAYLOAD")
+        hdrs["Content-Length"] = "3"
+        conn.request("PUT", "/bkt/u2", body=b"nop", headers=hdrs)
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 403
+
+        # streaming chunked scheme: explicit NotImplemented, not a
+        # confusing hash mismatch
+        hdrs = signer.sign("PUT", host, "/bkt/u3", {},
+                           "STREAMING-AWS4-HMAC-SHA256-PAYLOAD")
+        hdrs["Content-Length"] = "3"
+        conn.request("PUT", "/bkt/u3", body=b"xyz", headers=hdrs)
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 501
+    finally:
+        gw.stop()
+        v.close()

@@ -234,3 +234,32 @@ def test_truncate_and_fallocate_respect_quota(m):
     st, _ = m.truncate(CTX, ino, MIB // 2)
     assert st == 0
     assert m.fallocate(CTX, ino, 0, 0, MIB - 4096) == 0
+
+
+def test_quota_check_repairs_drift(m):
+    """`quota check --repair` path (ADVICE r2): recompute true usage from
+    a tree walk and heal counters drifted by the hint window."""
+    import struct
+
+    st, dino, _ = m.mkdir(CTX, ROOT_INODE, b"qd", 0o755)
+    assert m.set_dir_quota(CTX, dino, 1 << 30, 1000) == 0
+    st, f, _ = m.create(CTX, dino, b"f", 0o644)
+    m.close(CTX, f)
+
+    st, stored, actual = m.check_dir_quota(CTX, dino)
+    assert st == 0 and stored == actual  # normal path: no drift
+
+    # corrupt the stored usage (simulating a missed hint-window update)
+    sl, il, us, ui = m.get_dir_quota(dino)
+    m.client.txn(lambda tx: tx.set(
+        m._dirquota_key(dino), m._QFMT.pack(sl, il, us + 12345, ui + 7)
+    ))
+    st, stored, actual = m.check_dir_quota(CTX, dino)
+    assert st == 0 and stored != actual  # drift detected, not repaired
+    assert m.get_dir_quota(dino)[2] == us + 12345
+
+    st, stored, actual = m.check_dir_quota(CTX, dino, repair=True)
+    assert st == 0
+    assert m.get_dir_quota(dino)[2:] == actual  # healed
+    st, stored, actual = m.check_dir_quota(CTX, dino)
+    assert stored == actual
