@@ -511,11 +511,18 @@ class Server:
         if h is not None:
             h.lock_owner = owner
         end = end or (1 << 63) - 1
+        # Contention strategy matches the reference (redis_lock.go:86-88):
+        # retry at 1ms once then 10ms cadence — but a LOCAL unlock wakes
+        # the waiter immediately through the meta lock_wait condition
+        # instead of burning the full poll interval.
+        delay = 0.001
         while True:
+            gen = self.vfs.meta.lock_generation(hdr[1])
             st = self.vfs.meta.setlk(ctx, hdr[1], owner, ltype, start, end, pid)
             if st != _errno.EAGAIN or not wait:
                 return st
-            time.sleep(0.01)
+            self.vfs.meta.lock_wait(hdr[1], delay, gen)
+            delay = 0.01
 
     def _setlkw(self, ctx, hdr, body):
         # Blocking lock waits must not occupy the bounded worker pool (8

@@ -63,6 +63,14 @@ class BaseMeta(interface.Meta):
         self._free_slices = _IDBatch()
         self._heartbeat: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # blocking-lock wait/wake: local unlocks wake waiters immediately;
+        # remote unlocks are still caught by the poll cadence (the
+        # reference polls too — redis_lock.go:86-88 sleeps 1ms then 10ms).
+        # Per-inode [Condition, generation, n_waiters] triples; generation
+        # is the lost-wake guard (a release between the EAGAIN and the
+        # wait bumps it, so the waiter returns immediately).
+        self._lock_waits: dict[int, list] = {}
+        self._lock_waits_mu = threading.Lock()
 
     # -- abstract engine ops (reference base.go:51-125) --------------------
     def do_init(self, fmt: Format, force: bool) -> int: ...
@@ -178,6 +186,52 @@ class BaseMeta(interface.Meta):
     def do_load_acl(self, aid: int):
         """Interned ACL rule by id; engines without ACL support return None."""
         return None
+
+    # -- blocking-lock wait/wake -------------------------------------------
+    # Contended-waiter protocol: snapshot lock_generation(ino) BEFORE the
+    # setlk/flock attempt; on EAGAIN call lock_wait(ino, timeout, gen) —
+    # it returns as soon as a local unlock on that inode bumps the
+    # generation (even if the bump happened before the wait started), or
+    # after the poll interval for remote unlocks.
+
+    def lock_generation(self, ino: int) -> int:
+        with self._lock_waits_mu:
+            entry = self._lock_waits.get(ino)
+            if entry is None:
+                entry = self._lock_waits[ino] = [threading.Condition(), 0, 0]
+            return entry[1]
+
+    def lock_wait(self, ino: int, timeout: float, gen: int = -1) -> None:
+        """Park a blocked SETLKW/flock waiter until a local unlock on this
+        inode fires (generation != gen) or the poll interval elapses."""
+        with self._lock_waits_mu:
+            entry = self._lock_waits.get(ino)
+            if entry is None:
+                entry = self._lock_waits[ino] = [threading.Condition(), 0, 0]
+            entry[2] += 1
+        cond = entry[0]
+        try:
+            with cond:
+                if gen >= 0 and entry[1] != gen:
+                    return  # release already happened: don't sleep
+                cond.wait(timeout)
+        finally:
+            with self._lock_waits_mu:
+                entry[2] -= 1
+                if entry[2] <= 0:
+                    self._lock_waits.pop(ino, None)
+
+    def lock_released(self, ino: int) -> None:
+        """Wake this inode's local waiters after an unlock (engines call
+        this; waiters re-contend through the normal setlk/flock path, so a
+        spurious wake is harmless)."""
+        with self._lock_waits_mu:
+            entry = self._lock_waits.get(ino)
+            if entry is None:
+                return
+        with entry[0]:
+            entry[1] += 1
+            entry[0].notify_all()
 
     # -- POSIX ACLs (reference base.go:2757-2788 SetFacl/GetFacl) ----------
     def set_facl(self, ctx: Context, ino: int, acl_type: int, rule) -> int:

@@ -1790,7 +1790,10 @@ class KVMeta(BaseMeta):
                 tx.delete(key)
             return 0
 
-        return self._etxn(fn)
+        st = self._etxn(fn)
+        if st == 0 and ltype == "U":
+            self.lock_released(ino)
+        return st
 
     def setlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int, pid: int = 0) -> int:
         """POSIX record lock set/unset; non-blocking (reference Setlk)."""
@@ -1830,7 +1833,10 @@ class KVMeta(BaseMeta):
                 tx.delete(key)
             return 0
 
-        return self._etxn(fn)
+        st = self._etxn(fn)
+        if st == 0 and ltype == self.F_UNLCK:
+            self.lock_released(ino)
+        return st
 
     def getlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int) -> tuple[int, int, int, int, int]:
         """Returns (errno, ltype, start, end, pid); F_UNLCK if free."""
