@@ -30,6 +30,16 @@ class OpenFiles:
         self._files: dict[int, _OpenFile] = {}
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _content_changed(old: Attr, new: Attr) -> bool:
+        """Another writer touched the data: cached chunks must go
+        (reference openfile.go Update — mtime/length comparison)."""
+        return (
+            old.mtime != new.mtime
+            or old.mtimensec != new.mtimensec
+            or old.length != new.length
+        )
+
     def open(self, ino: int, attr: Optional[Attr]) -> None:
         with self._lock:
             of = self._files.get(ino)
@@ -38,6 +48,8 @@ class OpenFiles:
             else:
                 of.refs += 1
                 if attr is not None:
+                    if self._content_changed(of.attr, attr):
+                        of.chunks.clear()
                     of.attr = attr
                 of.last = time.time()
 
@@ -65,9 +77,15 @@ class OpenFiles:
             return of.attr
 
     def update(self, ino: int, attr: Attr) -> None:
+        """Refresh the cached attr; a content change detected here (mtime/
+        length moved, e.g. another client wrote) drops the chunk cache —
+        this is the cross-client invalidation path: stale chunks survive
+        at most `expire` seconds, until the next attr refetch."""
         with self._lock:
             of = self._files.get(ino)
             if of is not None:
+                if self._content_changed(of.attr, attr):
+                    of.chunks.clear()
                 of.attr = attr
                 of.last = time.time()
 
@@ -75,6 +93,11 @@ class OpenFiles:
         with self._lock:
             of = self._files.get(ino)
             if of is None:
+                return None
+            if time.time() - of.last > self.expire:
+                # attr is stale: chunks derived from it cannot be trusted
+                # either (they may predate another client's write)
+                of.chunks.clear()
                 return None
             return of.chunks.get(indx)
 
