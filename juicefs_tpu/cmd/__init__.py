@@ -92,6 +92,7 @@ def build_store(fmt: Format, args=None, meta=None) -> CachedStore:
 def main(argv: list[str] | None = None) -> int:
     from . import (
         bench,
+        config,
         dump,
         format as format_cmd,
         fsck,
@@ -114,7 +115,7 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     for mod in (
         format_cmd, mount, bench, objbench, gc, fsck, sync, dump, warmup,
-        info, gateway, stats, quota, meta_server,
+        info, gateway, stats, quota, meta_server, config,
     ):
         mod.add_parser(sub)
     args = parser.parse_args(argv)

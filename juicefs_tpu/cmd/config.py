@@ -1,0 +1,72 @@
+"""`config`: show or change volume settings (reference cmd/config.go).
+
+The Format record lives in the meta engine; changes here propagate to
+every live client through the session refresher's hot-reload check
+(meta/base.py _check_reload — reference OnReload interface.go:445).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..utils import get_logger
+
+logger = get_logger("cmd.config")
+
+# Format fields an operator may change after format time. Structural
+# fields (block_size, storage layout, encryption) are fixed at format.
+_MUTABLE = {
+    "trash_days": int,
+    "capacity": int,       # GiB on the CLI, bytes in the record
+    "inodes": int,
+    "hash_backend": str,
+    "enable_acl": bool,
+}
+
+
+def add_parser(sub):
+    p = sub.add_parser("config", help="show / change volume settings")
+    p.add_argument("meta_url")
+    p.add_argument("--trash-days", type=int, default=None)
+    p.add_argument("--capacity", type=int, default=None, help="GiB (0=unlimited)")
+    p.add_argument("--inodes", type=int, default=None, help="0=unlimited")
+    p.add_argument("--hash-backend", default=None,
+                   choices=["", "none", "cpu", "tpu", "xla", "pallas"])
+    import argparse as _argparse
+
+    p.add_argument("--enable-acl", dest="enable_acl", default=None,
+                   action=_argparse.BooleanOptionalAction,
+                   help="--enable-acl / --no-enable-acl")
+    p.set_defaults(func=run)
+
+
+def run(args) -> int:
+    from . import open_meta
+
+    m, fmt = open_meta(args.meta_url)
+    changes = {}
+    if args.trash_days is not None:
+        changes["trash_days"] = args.trash_days
+    if args.capacity is not None:
+        changes["capacity"] = args.capacity << 30
+    if args.inodes is not None:
+        changes["inodes"] = args.inodes
+    if args.hash_backend is not None:
+        changes["hash_backend"] = (
+            "" if args.hash_backend == "none" else args.hash_backend
+        )
+    if args.enable_acl is not None:
+        changes["enable_acl"] = args.enable_acl
+
+    if not changes:
+        print(fmt.remove_secret().to_json())
+        return 0
+
+    for k, v in changes.items():
+        setattr(fmt, k, v)
+    st = m.init(fmt, force=True)  # same-uuid overwrite of the record
+    if st:
+        print(f"config update: errno {st}")
+        return 1
+    print(json.dumps({"updated": sorted(changes)}))
+    return 0

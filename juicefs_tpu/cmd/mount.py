@@ -31,6 +31,10 @@ def add_parser(sub):
     p.add_argument("--metrics", default="",
                    help="host:port for the /metrics endpoint (reference "
                         "exposeMetrics; empty disables, port 0 auto-picks)")
+    p.add_argument("--takeover", action="store_true",
+                   help="seamless upgrade: adopt a running mount's fuse fd, "
+                        "open handles, and session (reference passfd.go)")
+    p.add_argument("--no-watchdog", action="store_true")
     p.add_argument("--no-bgjobs", action="store_true",
                    help="disable background maintenance on this mount")
     p.set_defaults(func=run)
@@ -50,8 +54,24 @@ def serve(args) -> int:
     from ..vfs.backup import BackgroundJobs
     from ..vfs.compact import compact_chunk
 
+    # seamless upgrade (reference cmd/passfd.go): ask a predecessor for
+    # its live fuse fd + open-handle state before creating our session
+    takeover = None
+    if getattr(args, "takeover", False):
+        from ..fuse.passfd import request_takeover
+
+        takeover = request_takeover(args.mountpoint)
+        if takeover is None:
+            logger.info("no predecessor at %s; fresh mount", args.mountpoint)
+
     m, fmt = open_meta(args.meta_url)
-    m.new_session(heartbeat=12.0)
+    if takeover is not None and takeover[1].get("sid"):
+        # inherit the predecessor's session: locks and sustained inodes
+        # keyed by sid remain valid across the swap
+        m.sid = int(takeover[1]["sid"])
+        m.start_heartbeat(12.0)
+    else:
+        m.new_session(heartbeat=12.0)
     store = build_store(fmt, args, meta=m)
     vfs = VFS(
         m,
@@ -79,8 +99,18 @@ def serve(args) -> int:
                     metrics_srv.host, metrics_srv.port)
     srv = Server(vfs, args.mountpoint, fsname=f"juicefs-tpu:{fmt.name}",
                  allow_other=args.allow_other)
-    srv.mount()
-    logger.info("volume %s mounted at %s", fmt.name, args.mountpoint)
+    if takeover is not None:
+        srv.adopt(takeover[0], takeover[1])
+        logger.info("volume %s taken over at %s (%d handles restored)",
+                    fmt.name, args.mountpoint,
+                    len(takeover[1].get("handles", [])))
+    else:
+        _clear_stale_mount(args.mountpoint)
+        srv.mount()
+        logger.info("volume %s mounted at %s", fmt.name, args.mountpoint)
+    srv.enable_takeover()  # we may be a future predecessor ourselves
+    watchdog_stop = _start_watchdog(args.mountpoint, srv) \
+        if not getattr(args, "no_watchdog", False) else None
 
     def _stop(signum, frame):
         srv.unmount()
@@ -90,10 +120,17 @@ def serve(args) -> int:
     try:
         srv.serve()
     finally:
+        if watchdog_stop is not None:
+            watchdog_stop.set()
         if metrics_srv is not None:
             metrics_srv.stop()
         if bg is not None:
             bg.stop()
+        if srv.handed_over:
+            # the successor owns the fd AND the session now: flush local
+            # state but leave the mount and session untouched
+            logger.info("handover complete; exiting without unmount")
+            m.sid = 0  # close_session must not clean the live session
         vfs.close()
         if store.indexer is not None:
             try:
@@ -102,6 +139,61 @@ def serve(args) -> int:
                 logger.warning("content indexer drain on unmount: %s", e)
         m.close_session()
     return 0
+
+
+def _clear_stale_mount(mountpoint: str) -> None:
+    """A predecessor that died without unmounting leaves the mountpoint in
+    'transport endpoint is not connected' state; lazy-unmount it so the
+    fresh mount can proceed (reference mount_unix.go stale-mount check)."""
+    import errno as _errno
+    import subprocess
+
+    try:
+        os.stat(mountpoint)
+    except OSError as e:
+        if e.errno in (_errno.ENOTCONN, _errno.EIO):
+            logger.warning("clearing stale mount at %s", mountpoint)
+            subprocess.run(["fusermount", "-u", "-z", mountpoint],
+                           capture_output=True)
+
+
+def _start_watchdog(mountpoint: str, srv) -> "threading.Event":
+    """Force-exit a wedged mount so the supervisor can restart it
+    (reference watchdog cmd/mount_unix.go:126). A probe thread statfs-es
+    the mountpoint; the watchdog only requires that SOME probe completed
+    recently — a hung FUSE loop stops all probes and trips it."""
+    import threading
+
+    stop = threading.Event()
+    last_ok = [time.time()]
+
+    def probe():
+        while not stop.is_set():
+            try:
+                os.statvfs(mountpoint)
+                last_ok[0] = time.time()
+            except OSError:
+                pass  # transient; staleness is judged by the watcher
+            stop.wait(5.0)
+
+    def watch():
+        import subprocess
+
+        while not stop.wait(10.0):
+            if srv.handed_over or srv._stop.is_set():
+                return
+            if time.time() - last_ok[0] > 120.0:
+                logger.error("mount unresponsive for 120s; aborting for restart")
+                # lazy-unmount first, else the dead connection leaves the
+                # mountpoint in ENOTCONN state and the supervisor's fresh
+                # worker can never remount over it
+                subprocess.run(["fusermount", "-u", "-z", mountpoint],
+                               capture_output=True)
+                os._exit(17)
+
+    threading.Thread(target=probe, daemon=True, name="watchdog-probe").start()
+    threading.Thread(target=watch, daemon=True, name="watchdog").start()
+    return stop
 
 
 def run(args) -> int:

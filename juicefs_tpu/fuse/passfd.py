@@ -1,0 +1,93 @@
+"""Seamless-upgrade fd passing (reference cmd/passfd.go:104-201 +
+pkg/vfs/handle.go:312-415 handle dump/restore).
+
+A serving mount listens on a per-mountpoint unix socket. A new process
+(`mount --takeover`) connects; the old server then:
+  1. pauses the kernel request loop and drains in-flight operations,
+  2. flushes every buffered writer (data is durable before handover),
+  3. dumps its open-handle table + session id as JSON,
+  4. sends the live /dev/fuse fd via SCM_RIGHTS with that state,
+and exits WITHOUT unmounting or closing the meta session. The new server
+adopts the fd, restores the handles (same fh numbers — the kernel keeps
+using them), inherits the session id (locks and sustained inodes keyed
+by sid stay valid), and resumes serving. Open files in applications
+survive the swap.
+"""
+
+from __future__ import annotations
+
+import array
+import hashlib
+import json
+import os
+import socket
+import struct
+
+from ..utils import get_logger
+
+logger = get_logger("fuse.passfd")
+
+_LEN = struct.Struct(">I")
+
+
+def sock_path(mountpoint: str) -> str:
+    """Per-mountpoint socket inside a 0700 per-user directory: a plain
+    /tmp path could be squatted by another local user (DoS at mount
+    time) or hijacked to receive the fd."""
+    digest = hashlib.sha1(os.path.abspath(mountpoint).encode()).hexdigest()[:12]
+    base = os.environ.get("XDG_RUNTIME_DIR") or f"/tmp/.jfs-tpu-{os.getuid()}"
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    if os.stat(base).st_uid != os.getuid():
+        raise PermissionError(f"takeover dir {base} owned by another user")
+    return os.path.join(base, f"upgrade-{digest}.sock")
+
+
+def send_state(conn: socket.socket, fuse_fd: int, state: dict) -> None:
+    """Send the fuse fd (SCM_RIGHTS) followed by the state JSON."""
+    blob = json.dumps(state).encode()
+    conn.sendmsg(
+        [_LEN.pack(len(blob))],
+        [(socket.SOL_SOCKET, socket.SCM_RIGHTS, array.array("i", [fuse_fd]))],
+    )
+    conn.sendall(blob)
+
+
+def recv_state(conn: socket.socket) -> tuple[int, dict]:
+    """Receive (fuse_fd, state) from the old server."""
+    fds = array.array("i")
+    msg, ancdata, _flags, _addr = conn.recvmsg(
+        _LEN.size, socket.CMSG_LEN(fds.itemsize)
+    )
+    if len(msg) != _LEN.size:
+        raise ConnectionError("takeover: short header")
+    for level, ctype, data in ancdata:
+        if level == socket.SOL_SOCKET and ctype == socket.SCM_RIGHTS:
+            fds.frombytes(data[: len(data) - len(data) % fds.itemsize])
+    if not fds:
+        raise ConnectionError("takeover: no fd received")
+    (n,) = _LEN.unpack(msg)
+    blob = b""
+    while len(blob) < n:
+        part = conn.recv(n - len(blob))
+        if not part:
+            raise ConnectionError("takeover: short state")
+        blob += part
+    return fds[0], json.loads(blob)
+
+
+def request_takeover(mountpoint: str, timeout: float = 30.0):
+    """New-process side: returns (fuse_fd, state) or None if no old server
+    is listening (fresh mount)."""
+    path = sock_path(mountpoint)
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    conn.settimeout(timeout)
+    try:
+        conn.connect(path)
+    except (FileNotFoundError, ConnectionRefusedError):
+        conn.close()
+        return None
+    try:
+        conn.sendall(b"TAKEOVER")
+        return recv_state(conn)
+    finally:
+        conn.close()

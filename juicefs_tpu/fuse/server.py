@@ -74,6 +74,15 @@ class Server:
         self._wlock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="fuse")
         self._stop = threading.Event()
+        self._workers = workers
+        self._paused = threading.Event()   # takeover: stop pulling requests
+        self._quiet = threading.Event()    # loop acknowledged the pause
+        self.handed_over = False           # fd given away: do not unmount
+        self._takeover_listener = None
+        # blocked SETLKW waiters (unique -> abort event): they live outside
+        # the pool and must be interrupted before a handover
+        self._lkw_waiters: dict[int, threading.Event] = {}
+        self._lkw_lock = threading.Lock()
         self._entry_ttl = vfs.conf.entry_timeout
         self._attr_ttl = vfs.conf.attr_timeout
         self._handlers = {
@@ -130,12 +139,26 @@ class Server:
         )
 
     def serve(self) -> None:
-        """Blocking request loop; returns after unmount."""
+        """Blocking request loop; returns after unmount or handover."""
+        import select
+
         if self._fd < 0:
             self.mount()
         bufsize = MAX_WRITE + 4096
         fd = self._fd
         while not self._stop.is_set():
+            # poll with timeout so pause/stop are honored even while the
+            # kernel is idle (needed for the takeover handshake)
+            try:
+                ready, _, _ = select.select([fd], [], [], 0.5)
+            except OSError:
+                break
+            if self._paused.is_set():
+                self._quiet.set()  # takeover thread may proceed
+                time.sleep(0.05)
+                continue
+            if not ready:
+                continue
             try:
                 req = os.read(fd, bufsize)
             except OSError as e:
@@ -147,7 +170,8 @@ class Server:
             if not req:
                 break
             self._pool.submit(self._dispatch, req)
-        self.vfs.flush_all()
+        if not self.handed_over:
+            self.vfs.flush_all()
 
     def serve_background(self) -> threading.Thread:
         self.mount()
@@ -157,6 +181,8 @@ class Server:
 
     def unmount(self) -> None:
         self._stop.set()
+        if self.handed_over:
+            return  # the new server owns the kernel connection now
         _umount(self.mountpoint)
         if self._fd >= 0:
             try:
@@ -164,6 +190,94 @@ class Server:
             except OSError:
                 pass
             self._fd = -1
+
+    # -- seamless upgrade (reference cmd/passfd.go, vfs/handle.go:312) -----
+
+    def enable_takeover(self) -> None:
+        """Listen for a successor on the per-mountpoint unix socket."""
+        import socket as _socket
+
+        from .passfd import send_state, sock_path
+
+        try:
+            path = sock_path(self.mountpoint)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            srv.bind(path)
+            os.chmod(path, 0o600)
+            srv.listen(1)
+        except OSError as e:
+            # a mount that cannot be upgraded later is still a mount
+            logger.warning("takeover listener unavailable: %s", e)
+            return
+        self._takeover_listener = srv
+
+        def listener():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                try:
+                    if conn.recv(8) != b"TAKEOVER":
+                        continue
+                    self._hand_over(conn)
+                    return
+                except Exception as e:
+                    logger.error("takeover failed: %s", e)
+                    # resume serving: fresh pool (the old one was drained)
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=self._workers, thread_name_prefix="fuse"
+                    )
+                    self._quiet.clear()
+                    self._paused.clear()
+                finally:
+                    conn.close()
+
+        threading.Thread(target=listener, daemon=True, name="takeover").start()
+
+    def _hand_over(self, conn) -> None:
+        from .passfd import send_state
+
+        logger.info("takeover requested: pausing request loop")
+        self._paused.set()
+        self._quiet.wait(10.0)  # serve loop acknowledged
+        # drain in-flight ops, then make all buffered data durable
+        self._pool.shutdown(wait=True)
+        # interrupt parked SETLKW waiters: they reply EINTR themselves
+        # before we give the connection away
+        with self._lkw_lock:
+            for ev in self._lkw_waiters.values():
+                ev.set()
+        deadline = time.time() + 5.0
+        while time.time() < deadline:  # waiters poll at <=10ms cadence
+            with self._lkw_lock:
+                if not self._lkw_waiters:
+                    break
+            time.sleep(0.01)
+        st = self.vfs.flush_all()
+        if st:
+            raise IOError(f"flush before handover failed: errno {st}")
+        state = {
+            "sid": getattr(self.vfs.meta, "sid", 0),
+            "handles": self.vfs.dump_handles(),
+        }
+        send_state(conn, self._fd, state)
+        self.handed_over = True
+        self._stop.set()
+        logger.info("handed fuse fd + %d handles to successor",
+                    len(state["handles"]))
+
+    def adopt(self, fd: int, state: dict) -> None:
+        """Successor side: take over a live kernel connection (INIT was
+        already negotiated by the predecessor) and restore open handles."""
+        self._fd = fd
+        self.vfs.restore_handles(state.get("handles", []))
+        logger.info("adopted fuse fd with %d handles",
+                    len(state.get("handles", [])))
 
     # -- plumbing ----------------------------------------------------------
 
@@ -503,7 +617,7 @@ class Server:
             return st
         return k.LK_OUT.pack(lstart, lend, ltype, lpid)
 
-    def _setlk(self, ctx, hdr, body, wait: bool = False):
+    def _setlk(self, ctx, hdr, body, wait: bool = False, abort=None):
         fh, owner, start, end, ltype, pid, _fl, _ = k.LK_IN.unpack_from(body)
         if not hasattr(self.vfs.meta, "setlk"):
             return _errno.ENOSYS
@@ -517,6 +631,8 @@ class Server:
         # instead of burning the full poll interval.
         delay = 0.001
         while True:
+            if abort is not None and abort.is_set():
+                return _errno.EINTR  # handover: app may retry the fcntl
             gen = self.vfs.meta.lock_generation(hdr[1])
             st = self.vfs.meta.setlk(ctx, hdr[1], owner, ltype, start, end, pid)
             if st != _errno.EAGAIN or not wait:
@@ -527,12 +643,22 @@ class Server:
     def _setlkw(self, ctx, hdr, body):
         # Blocking lock waits must not occupy the bounded worker pool (8
         # waiters would starve the unlock request and deadlock the mount):
-        # wait on a dedicated thread and reply asynchronously.
+        # wait on a dedicated thread and reply asynchronously. Waiters
+        # register so a seamless-upgrade handover can interrupt them with
+        # EINTR (the kernel never resends a swallowed request — an
+        # unanswered SETLKW would hang the application forever).
         unique = hdr[0]
+        abort = threading.Event()
+        with self._lkw_lock:
+            self._lkw_waiters[unique] = abort
 
         def waiter():
-            st = self._setlk(ctx, hdr, body, wait=True)
-            self._reply(unique, st if st else b"")
+            try:
+                st = self._setlk(ctx, hdr, body, wait=True, abort=abort)
+                self._reply(unique, st if st else b"")
+            finally:
+                with self._lkw_lock:
+                    self._lkw_waiters.pop(unique, None)
 
         threading.Thread(target=waiter, daemon=True, name="fuse-lkw").start()
         return ASYNC

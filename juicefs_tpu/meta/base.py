@@ -71,6 +71,7 @@ class BaseMeta(interface.Meta):
         # wait bumps it, so the waiter returns immediately).
         self._lock_waits: dict[int, list] = {}
         self._lock_waits_mu = threading.Lock()
+        self._reload_cbs: list[Callable] = []  # config hot-reload hooks
 
     # -- abstract engine ops (reference base.go:51-125) --------------------
     def do_init(self, fmt: Format, force: bool) -> int: ...
@@ -116,23 +117,73 @@ class BaseMeta(interface.Meta):
         return self.do_init(fmt, force)
 
     def load(self, check_version: bool = True) -> Format:
-        """Load Format JSON from the engine (reference base.go:317)."""
+        """Load Format JSON from the engine (reference base.go:317).
+
+        check_version gates old clients off newer volumes (reference
+        CheckVersion pkg/meta/config.go): a Format stamped with a higher
+        meta_version than this client understands refuses to load.
+        """
         data = self.do_load()
         if data is None:
             raise RuntimeError(f"database is not formatted: {self.addr}")
-        self.fmt = Format.from_json(data)
+        fmt = Format.from_json(data)
+        if check_version and fmt.meta_version > Format.meta_version:
+            raise RuntimeError(
+                f"volume meta version {fmt.meta_version} is newer than this "
+                f"client supports ({Format.meta_version}); upgrade the client"
+            )
+        self.fmt = fmt
+        self._fmt_raw = bytes(data) if isinstance(data, (bytes, bytearray)) else str(data)
         return self.fmt
+
+    def on_reload(self, cb: Callable[[Format], None]) -> None:
+        """Register a config hot-reload callback (reference OnReload
+        interface.go:445, cmd/mount.go:662): fired from the session
+        refresher when another client changes the volume Format (e.g.
+        `juicefs-tpu config --trash-days N`)."""
+        self._reload_cbs.append(cb)
+
+    def _check_reload(self) -> None:
+        data = self.do_load()
+        if data is None:
+            return
+        raw = bytes(data) if isinstance(data, (bytes, bytearray)) else str(data)
+        if raw == getattr(self, "_fmt_raw", None):
+            return
+        self._fmt_raw = raw  # don't re-log the same change every beat
+        new_fmt = Format.from_json(data)
+        if new_fmt.meta_version > Format.meta_version:
+            # same gate as load(): never adopt a format newer than this
+            # client understands (from_json drops fields it can't parse)
+            logger.error(
+                "volume upgraded to meta version %d (client supports %d); "
+                "keeping the old config — restart with a newer client",
+                new_fmt.meta_version, Format.meta_version,
+            )
+            return
+        self.fmt = new_fmt
+        logger.info("volume format reloaded")
+        for cb in self._reload_cbs:
+            try:
+                cb(self.fmt)
+            except Exception as e:
+                logger.warning("reload callback failed: %s", e)
 
     def new_session(self, record: bool = True, heartbeat: float = 0.0) -> int:
         """Register a client session (reference base.go:371 NewSession)."""
         if record:
             self.sid = self.do_new_session(new_session_info())
             if heartbeat > 0:
-                self._heartbeat = threading.Thread(
-                    target=self._session_refresher, args=(heartbeat,), daemon=True
-                )
-                self._heartbeat.start()
+                self.start_heartbeat(heartbeat)
         return self.sid
+
+    def start_heartbeat(self, interval: float) -> None:
+        """Refresh an (already set) session id periodically — also used
+        after a seamless-upgrade takeover adopts the predecessor's sid."""
+        self._heartbeat = threading.Thread(
+            target=self._session_refresher, args=(interval,), daemon=True
+        )
+        self._heartbeat.start()
 
     def close_session(self) -> None:
         self._stop.set()
@@ -144,6 +195,7 @@ class BaseMeta(interface.Meta):
         while not self._stop.wait(interval):
             try:
                 self.do_refresh_session(self.sid)
+                self._check_reload()
             except Exception as e:  # pragma: no cover - background resilience
                 logger.warning("session refresh failed: %s", e)
 

@@ -73,6 +73,18 @@ class HandleTable:
             self._by_ino.setdefault(ino, []).append(h)
             return h
 
+    def insert(self, fh: int, ino: int, flags: int = 0) -> Handle:
+        """Recreate a handle with a FIXED fh — seamless-upgrade restore
+        (reference handle.go:312-415): the kernel keeps using the fh
+        numbers the predecessor issued."""
+        with self._lock:
+            h = Handle(fh, ino, flags)
+            self._handles[fh] = h
+            self._by_ino.setdefault(ino, []).append(h)
+            if fh >= self._next:
+                self._next = fh + 1
+            return h
+
     def get(self, fh: int) -> Optional[Handle]:
         with self._lock:
             return self._handles.get(fh)

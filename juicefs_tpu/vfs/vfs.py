@@ -562,7 +562,44 @@ class VFS:
     def statfs(self, ctx) -> tuple[int, int, int, int]:
         return self.meta.statfs(ctx)
 
-    # -- lifecycle ---------------------------------------------------------
+    # -- lifecycle / seamless upgrade --------------------------------------
+
+    def dump_handles(self) -> list[dict]:
+        """Serializable open-handle state for fd-passing takeover
+        (reference vfs/handle.go:302 dump). Writers must be flushed by the
+        caller first — only structural state crosses the boundary."""
+        out = []
+        for h in self.handles.all():
+            if is_internal(h.ino):
+                continue  # internal virtual files don't survive a swap
+            out.append({
+                "fh": h.fh,
+                "ino": h.ino,
+                "flags": h.flags,
+                "lock_owner": h.lock_owner,
+                "dir": h.reader is None and h.writer is None,
+            })
+        return out
+
+    def restore_handles(self, dumped: list[dict]) -> None:
+        """Rebuild the handle table from a predecessor's dump
+        (reference vfs/handle.go:351 restore)."""
+        from ..meta.context import BACKGROUND
+
+        for d in dumped:
+            h = self.handles.insert(int(d["fh"]), int(d["ino"]), int(d["flags"]))
+            h.lock_owner = int(d.get("lock_owner", 0))
+            if d.get("dir"):
+                continue
+            accmode = h.flags & os.O_ACCMODE
+            if accmode in (os.O_RDONLY, os.O_RDWR):
+                h.reader = self.reader.open(h.ino)
+            if accmode in (os.O_WRONLY, os.O_RDWR):
+                st, attr = self.meta.getattr(BACKGROUND, h.ino)
+                h.writer = self.writer.open(h.ino, attr.length if st == 0 else 0)
+            # the meta open-file refcount moved with the session id; the
+            # local openfile cache just needs the entry back
+            self.meta.open(BACKGROUND, h.ino, 0)
 
     def flush_all(self) -> int:
         return self.writer.flush_all()

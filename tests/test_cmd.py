@@ -362,3 +362,54 @@ def test_internal_files_and_control(vol, capsys):
     assert b"getattr" in lines
     v.release(CTX, log_ino, lfh)
     v.close()
+
+
+def test_config_show_and_update(vol, capsys):
+    meta_url, bucket, tmp = vol
+    assert main(["config", meta_url]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["name"] == "testvol" and shown["trash_days"] == 1
+    assert main(["config", meta_url, "--trash-days", "7",
+                 "--capacity", "5"]) == 0
+    capsys.readouterr()
+    assert main(["config", meta_url]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["trash_days"] == 7
+    assert shown["capacity"] == 5 << 30
+    assert shown["uuid"]  # identity preserved across updates
+
+
+def test_config_hot_reload_reaches_live_client(vol):
+    """Another process's `config` change propagates to a mounted client
+    via the session refresher (reference OnReload interface.go:445)."""
+    import time as _time
+
+    from juicefs_tpu.cmd import open_meta
+
+    meta_url, bucket, tmp = vol
+    m, fmt = open_meta(meta_url)
+    m.new_session(heartbeat=0.1)
+    try:
+        seen = []
+        m.on_reload(lambda f: seen.append(f.trash_days))
+        assert main(["config", meta_url, "--trash-days", "9"]) == 0
+        deadline = _time.time() + 5
+        while _time.time() < deadline and not seen:
+            _time.sleep(0.05)
+        assert seen and seen[-1] == 9
+        assert m.fmt.trash_days == 9  # live client's view updated
+    finally:
+        m.close_session()
+
+
+def test_version_gating_refuses_newer_volume(vol):
+    """A volume stamped with a future meta_version must refuse to load
+    (reference CheckVersion pkg/meta/config.go)."""
+    from juicefs_tpu.cmd import open_meta
+
+    meta_url, bucket, tmp = vol
+    m, fmt = open_meta(meta_url)
+    fmt.meta_version = 99
+    assert m.init(fmt, force=True) == 0
+    with pytest.raises(RuntimeError, match="newer than this client"):
+        open_meta(meta_url)
