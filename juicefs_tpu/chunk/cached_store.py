@@ -286,7 +286,9 @@ class WSlice:
                 self._upload_block(indx, self.bs)
 
     def _upload_block(self, indx: int, bsize: int) -> None:
-        raw = bytes(self._blocks.pop(indx))
+        # keep the bytearray: a bytes() copy of every 4 MiB block would
+        # cost real bandwidth, and nothing mutates it after the pop
+        raw = self._blocks.pop(indx)
         if len(raw) < bsize:
             raw += b"\x00" * (bsize - len(raw))
         self._uploaded.add(indx)

@@ -13,17 +13,6 @@ from ..utils import get_logger
 
 logger = get_logger("cmd.config")
 
-# Format fields an operator may change after format time. Structural
-# fields (block_size, storage layout, encryption) are fixed at format.
-_MUTABLE = {
-    "trash_days": int,
-    "capacity": int,       # GiB on the CLI, bytes in the record
-    "inodes": int,
-    "hash_backend": str,
-    "enable_acl": bool,
-}
-
-
 def add_parser(sub):
     p = sub.add_parser("config", help="show / change volume settings")
     p.add_argument("meta_url")

@@ -35,6 +35,9 @@ def add_parser(sub):
                    help="seamless upgrade: adopt a running mount's fuse fd, "
                         "open handles, and session (reference passfd.go)")
     p.add_argument("--no-watchdog", action="store_true")
+    p.add_argument("--no-kernel-writeback", action="store_true",
+                   help="disable the kernel writeback cache (buffered "
+                        "writes then pay one FUSE round trip per syscall)")
     p.add_argument("--no-bgjobs", action="store_true",
                    help="disable background maintenance on this mount")
     p.set_defaults(func=run)
@@ -54,8 +57,14 @@ def serve(args) -> int:
     from ..vfs.backup import BackgroundJobs
     from ..vfs.compact import compact_chunk
 
-    # seamless upgrade (reference cmd/passfd.go): ask a predecessor for
-    # its live fuse fd + open-handle state before creating our session
+    # Validate meta + store FIRST: once the predecessor hands over its fd
+    # it exits, so a successor that dies during startup would leave the
+    # mount with no server at all (reference passfd takes the fd last).
+    m, fmt = open_meta(args.meta_url)
+    store = build_store(fmt, args, meta=m)
+
+    # seamless upgrade (reference cmd/passfd.go): ask the predecessor for
+    # its live fuse fd + open-handle state
     takeover = None
     if getattr(args, "takeover", False):
         from ..fuse.passfd import request_takeover
@@ -63,8 +72,6 @@ def serve(args) -> int:
         takeover = request_takeover(args.mountpoint)
         if takeover is None:
             logger.info("no predecessor at %s; fresh mount", args.mountpoint)
-
-    m, fmt = open_meta(args.meta_url)
     if takeover is not None and takeover[1].get("sid"):
         # inherit the predecessor's session: locks and sustained inodes
         # keyed by sid remain valid across the swap
@@ -72,7 +79,6 @@ def serve(args) -> int:
         m.start_heartbeat(12.0)
     else:
         m.new_session(heartbeat=12.0)
-    store = build_store(fmt, args, meta=m)
     vfs = VFS(
         m,
         store,
@@ -98,7 +104,8 @@ def serve(args) -> int:
         logger.info("metrics on http://%s:%d/metrics",
                     metrics_srv.host, metrics_srv.port)
     srv = Server(vfs, args.mountpoint, fsname=f"juicefs-tpu:{fmt.name}",
-                 allow_other=args.allow_other)
+                 allow_other=args.allow_other,
+                 writeback_cache=not getattr(args, "no_kernel_writeback", False))
     if takeover is not None:
         srv.adopt(takeover[0], takeover[1])
         logger.info("volume %s taken over at %s (%d handles restored)",
@@ -182,6 +189,11 @@ def _start_watchdog(mountpoint: str, srv) -> "threading.Event":
         while not stop.wait(10.0):
             if srv.handed_over or srv._stop.is_set():
                 return
+            if srv._paused.is_set():
+                # takeover in progress: the loop is intentionally not
+                # answering probes; don't shoot it mid-flush
+                last_ok[0] = time.time()
+                continue
             if time.time() - last_ok[0] > 120.0:
                 logger.error("mount unresponsive for 120s; aborting for restart")
                 # lazy-unmount first, else the dead connection leaves the

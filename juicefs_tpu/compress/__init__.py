@@ -42,10 +42,10 @@ class NoneCompressor(Compressor):
         return n
 
     def compress(self, data: bytes) -> bytes:
-        return bytes(data)
+        return data  # pass-through: copying every 4 MiB block costs real bandwidth
 
     def decompress(self, data: bytes, dst_size: int) -> bytes:
-        return bytes(data)
+        return data
 
 
 class _LZ4Lib:
@@ -82,6 +82,7 @@ class LZ4Compressor(Compressor):
         return self._lib.LZ4_compressBound(n)
 
     def compress(self, data: bytes) -> bytes:
+        data = bytes(data)  # c_char_p argtype: bytes only
         bound = self.compress_bound(len(data))
         dst = ctypes.create_string_buffer(bound)
         n = self._lib.LZ4_compress_default(data, dst, len(data), bound)
@@ -90,6 +91,7 @@ class LZ4Compressor(Compressor):
         return dst.raw[:n]
 
     def decompress(self, data: bytes, dst_size: int) -> bytes:
+        data = bytes(data)
         dst = ctypes.create_string_buffer(dst_size)
         n = self._lib.LZ4_decompress_safe(data, dst, len(data), dst_size)
         if n < 0:

@@ -82,6 +82,7 @@ FUSE_BIG_WRITES = 1 << 5
 FUSE_DONT_MASK = 1 << 6
 FUSE_AUTO_INVAL_DATA = 1 << 12
 FUSE_ASYNC_DIO = 1 << 15
+FUSE_WRITEBACK_CACHE = 1 << 16
 FUSE_PARALLEL_DIROPS = 1 << 18
 FUSE_POSIX_ACL = 1 << 20
 FUSE_MAX_PAGES = 1 << 22

@@ -65,6 +65,7 @@ class Server:
         fsname: str = "juicefs-tpu",
         allow_other: bool = False,
         workers: int = 8,
+        writeback_cache: bool = True,
     ):
         self.vfs = vfs
         self.mountpoint = os.path.abspath(mountpoint)
@@ -75,6 +76,7 @@ class Server:
         self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="fuse")
         self._stop = threading.Event()
         self._workers = workers
+        self._writeback_cache = writeback_cache  # offered; INIT decides
         self._paused = threading.Event()   # takeover: stop pulling requests
         self._quiet = threading.Event()    # loop acknowledged the pause
         self.handed_over = False           # fd given away: do not unmount
@@ -264,6 +266,9 @@ class Server:
         state = {
             "sid": getattr(self.vfs.meta, "sid", 0),
             "handles": self.vfs.dump_handles(),
+            # INIT was negotiated by THIS process; the successor must run
+            # with the same granted semantics (no renegotiation happens)
+            "writeback_cache": self.vfs.always_readable_handles,
         }
         send_state(conn, self._fd, state)
         self.handed_over = True
@@ -275,6 +280,7 @@ class Server:
         """Successor side: take over a live kernel connection (INIT was
         already negotiated by the predecessor) and restore open handles."""
         self._fd = fd
+        self.vfs.always_readable_handles = bool(state.get("writeback_cache"))
         self.vfs.restore_handles(state.get("handles", []))
         logger.info("adopted fuse fd with %d handles",
                     len(state.get("handles", [])))
@@ -283,7 +289,12 @@ class Server:
 
     def _dispatch(self, req: bytes) -> None:
         (length, opcode, unique, nodeid, uid, gid, pid, _) = k.IN_HEADER.unpack_from(req)
-        body = req[k.IN_HEADER_SIZE:length]
+        if opcode == k.WRITE:
+            # zero-copy: a 1 MiB write body would otherwise be copied
+            # twice (here and in the handler's payload slice)
+            body = memoryview(req)[k.IN_HEADER_SIZE:length]
+        else:
+            body = req[k.IN_HEADER_SIZE:length]
         ctx = Context(uid=uid, gid=gid, gids=(gid,), pid=pid)
         handler = self._handlers.get(opcode)
         try:
@@ -307,7 +318,8 @@ class Server:
             payload = out
         with self._wlock:
             try:
-                os.write(self._fd, hdr + payload)
+                # writev: no hdr+payload concat copy (1 MiB per big read)
+                os.writev(self._fd, (hdr, payload) if payload else (hdr,))
             except OSError as e:
                 if e.errno not in (_errno.ENOENT, _errno.ENODEV, _errno.EBADF):
                     raise
@@ -345,7 +357,21 @@ class Server:
             # caches ACL xattrs and invalidates them on set/remove itself;
             # without this flag a removexattr can leave a stale cached ACL.
             ours |= k.FUSE_POSIX_ACL
+        if self._writeback_cache and not self.vfs.conf.readonly:
+            # Buffered writes aggregate in the kernel page cache and land
+            # here as large asynchronous WRITEs instead of one synchronous
+            # round trip per write() syscall (the dominant cost of a
+            # userspace server). close-to-open semantics hold: FLUSH on
+            # close and FSYNC still force everything down.
+            ours |= k.FUSE_WRITEBACK_CACHE
         out_flags = ours & flags
+        # Only what the kernel actually GRANTED governs server behavior:
+        # with writeback cache the kernel owns O_APPEND positioning and
+        # may read on write-only handles (vfs.always_readable_handles);
+        # without it the VFS must keep deriving EOF itself.
+        self.vfs.always_readable_handles = bool(
+            out_flags & k.FUSE_WRITEBACK_CACHE
+        )
         return k.INIT_OUT.pack(
             k.FUSE_KERNEL_VERSION,
             min(minor, k.FUSE_KERNEL_MINOR),

@@ -80,6 +80,31 @@ class WebDAVServer(HTTPAdapter):
                     data = dav.fs.read_file(self._path())
                 except FSError as e:
                     return self._err(e)
+                # RFC 7233 single byte-range (bytes=a-b / bytes=a- ); an
+                # invalid spec (inverted or unparsable) ignores the header
+                start = None
+                rng = self.headers.get("Range", "")
+                if rng.startswith("bytes=") and "," not in rng:
+                    total = len(data)
+                    try:
+                        a, _, b = rng[6:].partition("-")
+                        s = int(a) if a else max(0, total - int(b))
+                        e = min(int(b), total - 1) if (a and b) else total - 1
+                        if s >= total:
+                            return self._empty(416)
+                        if s <= e:
+                            start, end = s, e
+                    except ValueError:
+                        pass
+                if start is not None:
+                    part = data[start:end + 1]
+                    self.send_response(206)
+                    self.send_header("Content-Range",
+                                     f"bytes {start}-{end}/{total}")
+                    self.send_header("Content-Length", str(len(part)))
+                    self.end_headers()
+                    self.wfile.write(part)
+                    return
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

@@ -50,10 +50,17 @@ def _s3_factory(addr: str) -> ObjectStorage:
     return S3Storage(addr)
 
 
+def _webdav_factory(addr: str) -> ObjectStorage:
+    from .webdav import WebDAVStorage
+
+    return WebDAVStorage(addr)
+
+
 register("file", lambda addr: FileStorage(addr))
 register("mem", lambda addr: MemStorage(addr))
 register("s3", _s3_factory)
 register("minio", _s3_factory)
+register("webdav", _webdav_factory)
 
 __all__ = [
     "Obj",

@@ -356,10 +356,15 @@ class VFS:
         fh = self._new_file_handle(ino, attr.length, flags)
         return 0, attr, fh
 
+    # With the kernel writeback cache the kernel issues READs on handles
+    # the app opened O_WRONLY (read-modify-write of partial pages); the
+    # FUSE server sets this so such handles carry a reader too.
+    always_readable_handles = False
+
     def _new_file_handle(self, ino: int, length: int, flags: int) -> int:
         h = self.handles.new(ino, flags)
         accmode = flags & os.O_ACCMODE
-        if accmode in (os.O_RDONLY, os.O_RDWR):
+        if accmode in (os.O_RDONLY, os.O_RDWR) or self.always_readable_handles:
             h.reader = self.reader.open(ino)
         if accmode in (os.O_WRONLY, os.O_RDWR):
             h.writer = self.writer.open(ino, length)
@@ -401,7 +406,10 @@ class VFS:
             return _errno.EFBIG
         h.begin_write()
         try:
-            if h.flags & os.O_APPEND:
+            # Kernel-writeback mode: the kernel positions O_APPEND writes
+            # itself and flushes whole cached pages at explicit offsets —
+            # re-deriving EOF here would double-place the data.
+            if h.flags & os.O_APPEND and not self.always_readable_handles:
                 with self._append_lock:
                     st, attr = self.getattr(ctx, ino)
                     if st != 0:
@@ -592,7 +600,7 @@ class VFS:
             if d.get("dir"):
                 continue
             accmode = h.flags & os.O_ACCMODE
-            if accmode in (os.O_RDONLY, os.O_RDWR):
+            if accmode in (os.O_RDONLY, os.O_RDWR) or self.always_readable_handles:
                 h.reader = self.reader.open(h.ino)
             if accmode in (os.O_WRONLY, os.O_RDWR):
                 st, attr = self.meta.getattr(BACKGROUND, h.ino)

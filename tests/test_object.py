@@ -54,8 +54,31 @@ def _make_s3_env(tmp_path):
     return gw, v, f"s3://testak:testsk@127.0.0.1:{port}"
 
 
+def _make_webdav_env(tmp_path):
+    """WebDAV-gateway-backed endpoint: exercises the webdav:// driver over
+    the real DAV wire protocol (reference pkg/object/webdav.go)."""
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.fs import FileSystem
+    from juicefs_tpu.gateway.webdav import WebDAVServer
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.vfs import VFS
+
+    m = new_client("mem://")
+    m.init(Format(name="davt", storage="mem", block_size=256), force=False)
+    m.new_session()
+    cs = CachedStore(
+        create_storage("mem://"),
+        ChunkConfig(block_size=256 << 10, cache_dirs=(str(tmp_path / "dc"),)),
+    )
+    v = VFS(m, cs)
+    srv = WebDAVServer(FileSystem(v), port=0)
+    port = srv.start()
+    return srv, v, f"webdav://127.0.0.1:{port}/vol"
+
+
 @pytest.fixture(params=[
-    "mem", "file", "prefix", "sharded", "checksum", "encrypted", "enc+sum", "s3",
+    "mem", "file", "prefix", "sharded", "checksum", "encrypted", "enc+sum",
+    "s3", "webdav",
 ])
 def store(request, tmp_path):
     if request.param == "s3":
@@ -64,6 +87,14 @@ def store(request, tmp_path):
         s.create()
         yield s
         gw.stop()
+        v.close()
+        return
+    if request.param == "webdav":
+        srv, v, ep = _make_webdav_env(tmp_path)
+        s = create_storage(ep)
+        s.create()
+        yield s
+        srv.stop()
         v.close()
         return
     s = _stores(tmp_path)[request.param]
