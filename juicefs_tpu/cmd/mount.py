@@ -57,11 +57,16 @@ def serve(args) -> int:
     from ..vfs.backup import BackgroundJobs
     from ..vfs.compact import compact_chunk
 
-    # Validate meta + store FIRST: once the predecessor hands over its fd
-    # it exits, so a successor that dies during startup would leave the
-    # mount with no server at all (reference passfd takes the fd last).
+    # Validate meta + storage config FIRST: once the predecessor hands
+    # over its fd it exits, so a successor that dies during startup would
+    # leave the mount with no server at all. The store itself is built
+    # only AFTER the handover — CachedStore.__init__ runs writeback
+    # staging recovery, which must not race the predecessor's live
+    # staging writes in the shared cache directory.
+    from . import storage_for
+
     m, fmt = open_meta(args.meta_url)
-    store = build_store(fmt, args, meta=m)
+    storage_for(fmt)  # raises on a broken storage configuration
 
     # seamless upgrade (reference cmd/passfd.go): ask the predecessor for
     # its live fuse fd + open-handle state
@@ -72,6 +77,7 @@ def serve(args) -> int:
         takeover = request_takeover(args.mountpoint)
         if takeover is None:
             logger.info("no predecessor at %s; fresh mount", args.mountpoint)
+    store = build_store(fmt, args, meta=m)
     if takeover is not None and takeover[1].get("sid"):
         # inherit the predecessor's session: locks and sustained inodes
         # keyed by sid remain valid across the swap

@@ -88,14 +88,23 @@ class WebDAVServer(HTTPAdapter):
                     total = len(data)
                     try:
                         a, _, b = rng[6:].partition("-")
-                        s = int(a) if a else max(0, total - int(b))
-                        e = min(int(b), total - 1) if (a and b) else total - 1
-                        if s >= total:
-                            return self._empty(416)
-                        if s <= e:
+                        if a and b:
+                            s, e = int(a), min(int(b), total - 1)
+                            valid = s >= 0 and int(b) >= s  # inverted -> ignore
+                        elif a:
+                            s, e = int(a), total - 1
+                            valid = s >= 0
+                        else:
+                            # suffix-range: last N bytes; N must be a plain
+                            # non-negative integer or the spec is invalid
+                            valid = b.isdigit()
+                            s, e = (max(0, total - int(b)), total - 1) if valid else (0, 0)
+                        if valid:
+                            if s >= total:
+                                return self._empty(416)  # unsatisfiable
                             start, end = s, e
                     except ValueError:
-                        pass
+                        pass  # malformed: ignore the header (RFC 7233)
                 if start is not None:
                     part = data[start:end + 1]
                     self.send_response(206)
@@ -146,7 +155,9 @@ class WebDAVServer(HTTPAdapter):
                     dav.fs.mkdir(self._path().rstrip("/"))
                 except FSError as e:
                     if e.errno == _errno.ENOENT:
-                        return self._empty(409)
+                        return self._empty(409)  # missing parent (RFC 4918)
+                    if e.errno == _errno.EEXIST:
+                        return self._empty(405)  # already exists (RFC 4918)
                     return self._err(e)
                 self._empty(201)
 

@@ -50,13 +50,21 @@ class WebDAVStorage(ObjectStorage):
 
     def _request(self, method: str, key: str, body: bytes | None = None,
                  headers: dict | None = None):
+        return self._do(method, self._url(key), body, headers)
+
+    def _request_abs(self, method: str, abspath: str,
+                     body: bytes | None = None, headers: dict | None = None):
+        """Like _request but with a server-absolute path (no base prefix)."""
+        return self._do(method, urllib.parse.quote(abspath), body, headers)
+
+    def _do(self, method: str, quoted_path: str, body, headers):
         """Keep-alive request with one redial on a broken connection
         (same pattern as S3Storage._conn — a fresh TCP handshake per
         block op would dominate small-op latency)."""
         for attempt in (0, 1):
             conn = self._conn()
             try:
-                conn.request(method, self._url(key), body=body,
+                conn.request(method, quoted_path, body=body,
                              headers=headers or {})
                 resp = conn.getresponse()
                 data = resp.read()
@@ -84,32 +92,35 @@ class WebDAVStorage(ObjectStorage):
             end = "" if limit < 0 else str(off + limit - 1)
             headers["Range"] = f"bytes={off}-{end}"
         status, _, data = self._request("GET", key, headers=headers)
+        if status == 416:
+            return b""  # at/past EOF: match every other driver's b""
         self._check(status, key)
         if ranged and status == 200:
             # server ignored the Range header: slice client-side
             data = data[off:] if limit < 0 else data[off:off + limit]
         return data
 
-    def put(self, key: str, data: bytes) -> None:
-        status, _, _ = self._request("PUT", key, body=bytes(data))
+    def put(self, key: str, data) -> None:
+        # data passes through unchanged: http.client takes bytes-like
+        # bodies, and copying every 4 MiB block costs real bandwidth
+        status, _, _ = self._request("PUT", key, body=data)
         if status == 409:  # missing parent collections (RFC 4918)
             self._mkcols(posixpath.dirname(key) + "/")
-            status, _, _ = self._request("PUT", key, body=bytes(data))
+            status, _, _ = self._request("PUT", key, body=data)
         self._check(status, key)
 
     def _mkcols(self, dirpath: str) -> None:
-        """Create the base collection and every intermediate one (paths
-        are key-relative; '' means the base itself)."""
-        if self.base != "/":
-            status, _, _ = self._request("MKCOL", "")
-            if status not in (201, 405, 409):
-                raise IOError(f"webdav MKCOL {self.base}: HTTP {status}")
-        parts = [p for p in dirpath.split("/") if p]
-        cur = ""
-        for p in parts:
+        """Create every collection from the server root down: the base may
+        itself be multi-segment (webdav://host/a/b), and each segment's
+        MKCOL only succeeds once its parent exists — so 409 here is a
+        REAL failure, never 'already exists' (that is 405)."""
+        conn_path = self.base.strip("/") + "/" + dirpath
+        segs = [p for p in conn_path.split("/") if p]
+        cur = "/"
+        for p in segs:
             cur += p + "/"
-            status, _, _ = self._request("MKCOL", cur)
-            if status not in (201, 405, 409):  # 405 = already exists
+            status, _, _ = self._request_abs("MKCOL", cur)
+            if status not in (201, 405):
                 raise IOError(f"webdav MKCOL {cur}: HTTP {status}")
 
     def delete(self, key: str) -> None:

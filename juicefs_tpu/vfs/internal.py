@@ -191,8 +191,10 @@ class InternalFiles:
         if ino != CONTROL_INO:
             return _errno.EACCES
         try:
-            cmd = json.loads(data)
-        except ValueError:
+            # bytes() first: the FUSE path delivers memoryviews, which
+            # json.loads rejects with TypeError
+            cmd = json.loads(bytes(data))
+        except (ValueError, TypeError):
             return _errno.EINVAL
         result = self.control.handle(ctx, cmd)
         self._bufs[fh] = json.dumps(result).encode()

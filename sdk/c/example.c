@@ -1,0 +1,76 @@
+/* Consumer of the libjfs C ABI: formats nothing (the harness formats),
+ * mounts a volume, exercises the full surface, prints PASS/FAIL lines.
+ * Built and executed by tests/test_sdk_c.py — the proof that languages
+ * other than Python can drive the filesystem through libjfs.so, the way
+ * the reference's Java SDK drives its Go libjfs. */
+
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "jfs.h"
+
+static int failures = 0;
+
+#define CHECK(cond, what)                              \
+    do {                                               \
+        if (cond) {                                    \
+            printf("PASS %s\n", what);                 \
+        } else {                                       \
+            printf("FAIL %s\n", what);                 \
+            failures++;                                \
+        }                                              \
+    } while (0)
+
+int main(int argc, char **argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s META_URL\n", argv[0]);
+        return 2;
+    }
+    int64_t mid = jfs_init(argv[1]);
+    CHECK(mid > 0, "jfs_init");
+    if (mid <= 0) return 1;
+
+    CHECK(jfs_mkdir(mid, "/cdir", 0755) == 0, "jfs_mkdir");
+
+    int64_t fd = jfs_open(mid, "/cdir/hello.txt", O_CREAT | O_RDWR, 0644);
+    CHECK(fd > 0, "jfs_open(create)");
+    const char msg[] = "written from C through libjfs";
+    CHECK(jfs_pwrite(mid, fd, msg, sizeof(msg) - 1, 0) ==
+              (int64_t)(sizeof(msg) - 1),
+          "jfs_pwrite");
+    CHECK(jfs_flush(mid, fd) == 0, "jfs_flush");
+
+    char buf[128] = {0};
+    int64_t n = jfs_pread(mid, fd, buf, sizeof(buf), 0);
+    CHECK(n == (int64_t)(sizeof(msg) - 1) && memcmp(buf, msg, (size_t)n) == 0,
+          "jfs_pread roundtrip");
+    CHECK(jfs_close(mid, fd) == 0, "jfs_close");
+
+    struct jfs_stat st;
+    CHECK(jfs_stat(mid, "/cdir/hello.txt", &st) == 0 &&
+              st.size == (int64_t)(sizeof(msg) - 1) && (st.mode & 0777) == 0644,
+          "jfs_stat");
+
+    char names[512];
+    int64_t need = jfs_listdir(mid, "/cdir", names, sizeof(names));
+    CHECK(need > 0 && strcmp(names, "hello.txt") == 0, "jfs_listdir");
+
+    CHECK(jfs_rename(mid, "/cdir/hello.txt", "/cdir/renamed.txt") == 0,
+          "jfs_rename");
+    CHECK(jfs_stat(mid, "/cdir/hello.txt", &st) == -2 /* -ENOENT */,
+          "jfs_stat ENOENT after rename");
+    CHECK(jfs_truncate(mid, "/cdir/renamed.txt", 7) == 0, "jfs_truncate");
+    CHECK(jfs_stat(mid, "/cdir/renamed.txt", &st) == 0 && st.size == 7,
+          "jfs_stat after truncate");
+
+    int64_t vfs[4];
+    CHECK(jfs_statvfs(mid, vfs) == 0 && vfs[0] > 0, "jfs_statvfs");
+
+    CHECK(jfs_unlink(mid, "/cdir/renamed.txt") == 0, "jfs_unlink");
+    CHECK(jfs_rmdir(mid, "/cdir") == 0, "jfs_rmdir");
+    CHECK(jfs_term(mid) == 0, "jfs_term");
+
+    printf(failures == 0 ? "ALL OK\n" : "FAILURES: %d\n", failures);
+    return failures == 0 ? 0 : 1;
+}

@@ -1,0 +1,167 @@
+/* JNA binding over the libjfs C ABI (sdk/c/jfs.h).
+ *
+ * Role-match to the reference's JuiceFileSystemImpl JNA layer over its
+ * Go c-shared libjfs (reference sdk/java/libjfs/main.go:409). Every
+ * native call returns >= 0 on success or -errno; this wrapper converts
+ * failures to IOException. */
+
+package io.juicefs.tpu;
+
+import com.sun.jna.Library;
+import com.sun.jna.Native;
+import com.sun.jna.Structure;
+
+import java.io.IOException;
+import java.nio.charset.StandardCharsets;
+import java.util.Arrays;
+import java.util.List;
+
+public class JuiceFS implements AutoCloseable {
+
+    public static final int O_RDONLY = 0;
+    public static final int O_WRONLY = 1;
+    public static final int O_RDWR = 2;
+    public static final int O_CREAT = 0100;
+    public static final int O_TRUNC = 01000;
+    public static final int O_APPEND = 02000;
+
+    public interface LibJfs extends Library {
+        LibJfs INSTANCE = Native.load("jfs", LibJfs.class);
+
+        int jfs_sdk_version();
+
+        long jfs_init(String metaUrl);
+
+        int jfs_term(long mid);
+
+        long jfs_open(long mid, String path, int flags, int mode);
+
+        int jfs_close(long mid, long fd);
+
+        long jfs_pread(long mid, long fd, byte[] buf, long n, long off);
+
+        long jfs_pwrite(long mid, long fd, byte[] buf, long n, long off);
+
+        int jfs_flush(long mid, long fd);
+
+        int jfs_mkdir(long mid, String path, int mode);
+
+        int jfs_rmdir(long mid, String path);
+
+        int jfs_unlink(long mid, String path);
+
+        int jfs_rename(long mid, String src, String dst);
+
+        int jfs_truncate(long mid, String path, long length);
+
+        int jfs_stat(long mid, String path, Stat out);
+
+        long jfs_listdir(long mid, String path, byte[] buf, long bufsize);
+
+        int jfs_statvfs(long mid, long[] out);
+    }
+
+    @Structure.FieldOrder({"size", "mode", "uid", "gid", "atime", "mtime",
+                           "ctime", "nlink"})
+    public static class Stat extends Structure {
+        public long size;
+        public int mode;
+        public int uid;
+        public int gid;
+        public long atime;
+        public long mtime;
+        public long ctime;
+        public int nlink;
+    }
+
+    private final long mid;
+
+    public JuiceFS(String metaUrl) throws IOException {
+        mid = check(LibJfs.INSTANCE.jfs_init(metaUrl), "init " + metaUrl);
+    }
+
+    private static long check(long rc, String what) throws IOException {
+        if (rc < 0) {
+            throw new IOException(what + ": errno " + (-rc));
+        }
+        return rc;
+    }
+
+    public long open(String path, int flags, int mode) throws IOException {
+        return check(LibJfs.INSTANCE.jfs_open(mid, path, flags, mode), path);
+    }
+
+    public void close(long fd) throws IOException {
+        check(LibJfs.INSTANCE.jfs_close(mid, fd), "close");
+    }
+
+    public int pread(long fd, byte[] buf, long off) throws IOException {
+        return (int) check(
+            LibJfs.INSTANCE.jfs_pread(mid, fd, buf, buf.length, off), "pread");
+    }
+
+    public int pwrite(long fd, byte[] buf, long off) throws IOException {
+        return (int) check(
+            LibJfs.INSTANCE.jfs_pwrite(mid, fd, buf, buf.length, off), "pwrite");
+    }
+
+    public void flush(long fd) throws IOException {
+        check(LibJfs.INSTANCE.jfs_flush(mid, fd), "flush");
+    }
+
+    public void mkdir(String path, int mode) throws IOException {
+        check(LibJfs.INSTANCE.jfs_mkdir(mid, path, mode), path);
+    }
+
+    public void rmdir(String path) throws IOException {
+        check(LibJfs.INSTANCE.jfs_rmdir(mid, path), path);
+    }
+
+    public void unlink(String path) throws IOException {
+        check(LibJfs.INSTANCE.jfs_unlink(mid, path), path);
+    }
+
+    public void rename(String src, String dst) throws IOException {
+        check(LibJfs.INSTANCE.jfs_rename(mid, src, dst), src);
+    }
+
+    public void truncate(String path, long length) throws IOException {
+        check(LibJfs.INSTANCE.jfs_truncate(mid, path, length), path);
+    }
+
+    public Stat stat(String path) throws IOException {
+        Stat st = new Stat();
+        check(LibJfs.INSTANCE.jfs_stat(mid, path, st), path);
+        return st;
+    }
+
+    public List<String> listdir(String path) throws IOException {
+        byte[] buf = new byte[64 << 10];
+        long need = check(
+            LibJfs.INSTANCE.jfs_listdir(mid, path, buf, buf.length), path);
+        if (need > buf.length) {
+            buf = new byte[(int) need];
+            check(LibJfs.INSTANCE.jfs_listdir(mid, path, buf, buf.length), path);
+        }
+        String joined = new String(buf, StandardCharsets.UTF_8).trim();
+        if (joined.isEmpty()) {
+            return List.of();
+        }
+        return Arrays.asList(joined.split("\n"));
+    }
+
+    public long[] statvfs() throws IOException {
+        long[] out = new long[4];
+        check(LibJfs.INSTANCE.jfs_statvfs(mid, out), "statvfs");
+        return out;
+    }
+
+    public void terminate() throws IOException {
+        check(LibJfs.INSTANCE.jfs_term(mid), "term");
+    }
+
+    @Override
+    public void close() throws IOException {
+        terminate();
+    }
+}

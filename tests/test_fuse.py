@@ -311,3 +311,20 @@ def test_metrics_endpoint_during_mount(mnt):
             assert e.code == 404
     finally:
         srv.stop()
+
+
+def test_control_file_through_kernel(mnt):
+    """The .control protocol over a real mount (code-review r3: memoryview
+    WRITE bodies broke json.loads in internal.write with EIO)."""
+    import json as _json
+
+    with open(os.path.join(mnt, "sub.txt"), "wb") as f:
+        f.write(b"x" * 1234)
+    fd = os.open(os.path.join(mnt, ".control"), os.O_RDWR)
+    try:
+        os.write(fd, _json.dumps({"op": "summary", "inode": 1}).encode())
+        resp = _json.loads(os.pread(fd, 1 << 16, 0))
+        assert resp["errno"] == 0
+        assert resp["size"] >= 1234
+    finally:
+        os.close(fd)
