@@ -15,7 +15,9 @@ the dirs (reference disk_cache.go:922 cacheManager).
 
 from __future__ import annotations
 
+import fcntl
 import os
+import struct
 import threading
 import time
 import zlib
@@ -25,20 +27,58 @@ from ..utils import get_logger
 
 logger = get_logger("chunk.cache")
 
+_TRAILER = struct.Struct("<4sI")  # magic + crc32 of the payload
+_MAGIC = b"JFC1"
+
 
 class DiskCache:
-    def __init__(self, dirpath: str, capacity: int = 1 << 30):
+    def __init__(self, dirpath: str, capacity: int = 1 << 30,
+                 checksum: bool = True, lock_timeout: float = 10.0):
         self.dir = dirpath
         self.capacity = capacity
+        self.checksum = checksum
+        self.lock_timeout = lock_timeout
         self._raw = os.path.join(dirpath, "raw")
         self._staging = os.path.join(dirpath, "rawstaging")
         os.makedirs(self._raw, exist_ok=True)
         os.makedirs(self._staging, exist_ok=True)
+        self._acquire_dir_lock(dirpath)
         self._lock = threading.Lock()
         # key -> (size, atime); rebuilt from disk on startup
         self._index: dict[str, tuple[int, float]] = {}
         self._used = 0
         self._scan_existing()
+
+    def _acquire_dir_lock(self, dirpath: str) -> None:
+        """Exclusive per-directory lock file (reference disk_cache.go:
+        157-198 lock-file liveness): two processes sharing one cache dir
+        would corrupt each other's eviction accounting and staging."""
+        path = os.path.join(dirpath, ".lock")
+        self._lockfd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        # brief retry: a seamless-upgrade predecessor releases its lock at
+        # process exit moments after handing the mount over
+        deadline = time.time() + self.lock_timeout
+        while True:
+            try:
+                fcntl.flock(self._lockfd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.time() < deadline:
+                    time.sleep(0.1)
+                    continue
+                owner = b"?"
+                try:
+                    owner = os.pread(self._lockfd, 32, 0).strip(b"\x00") or b"?"
+                except OSError:
+                    pass
+                os.close(self._lockfd)
+                raise RuntimeError(
+                    f"cache dir {dirpath} is in use by another process "
+                    f"(pid {owner.decode(errors='replace')}); pick a "
+                    f"different --cache-dir per mount"
+                )
+        os.ftruncate(self._lockfd, 0)
+        os.pwrite(self._lockfd, str(os.getpid()).encode(), 0)
 
     def _scan_existing(self) -> None:
         for dirpath, _, filenames in os.walk(self._raw):
@@ -70,6 +110,11 @@ class DiskCache:
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(data)
+                if self.checksum:
+                    # trailer checked on every load: silent media bitrot
+                    # becomes a cache miss instead of corrupt reads
+                    # (reference disk_cache.go checksum-on-read option)
+                    f.write(_TRAILER.pack(_MAGIC, zlib.crc32(data)))
             os.replace(tmp, path)
         except OSError as e:
             logger.warning("cache write failed %s: %s", key, e)
@@ -91,10 +136,28 @@ class DiskCache:
                     return f.read()
             except OSError:
                 return None
+        if self.checksum:
+            if len(data) >= _TRAILER.size:
+                magic, crc = _TRAILER.unpack_from(data, len(data) - _TRAILER.size)
+            else:
+                magic = b""
+            if magic != _MAGIC:
+                self._drop_corrupt(key, "missing checksum trailer")
+                return None
+            data = data[: len(data) - _TRAILER.size]
+            if zlib.crc32(data) != crc:
+                self._drop_corrupt(key, "crc mismatch (bitrot?)")
+                return None
         with self._lock:
             if key in self._index:
                 self._index[key] = (len(data), time.time())
         return data
+
+    def _drop_corrupt(self, key: str, why: str) -> None:
+        """Self-heal: evict the bad entry; the caller refetches from the
+        object store."""
+        logger.warning("cache entry %s dropped: %s", key, why)
+        self.remove(key)
 
     def remove(self, key: str) -> None:
         with self._lock:
@@ -150,10 +213,18 @@ class DiskCache:
 
     def uploaded(self, key: str, size: int) -> None:
         """Move a staged block into the normal cache after upload
-        (reference disk_cache.go uploaded)."""
+        (reference disk_cache.go uploaded). Staging files are raw (crash
+        recovery reads them verbatim), so the checksum trailer is added
+        on the way into raw/."""
         spath = self._stage_path(key)
-        rpath = self._raw_path(key)
         try:
+            if self.checksum:
+                with open(spath, "rb") as f:
+                    data = f.read()
+                os.unlink(spath)
+                self.cache(key, data)
+                return
+            rpath = self._raw_path(key)
             os.makedirs(os.path.dirname(rpath), exist_ok=True)
             os.replace(spath, rpath)
             st = os.stat(rpath)
@@ -181,12 +252,26 @@ class DiskCache:
         with self._lock:
             return len(self._index), self._used
 
+    def close(self) -> None:
+        """Release the dir lock (a crashed process releases it
+        automatically; this is for orderly shutdown and tests)."""
+        if getattr(self, "_lockfd", -1) >= 0:
+            try:
+                os.close(self._lockfd)
+            except OSError:
+                pass
+            self._lockfd = -1
+
 
 class CacheManager:
     """Hash keys over multiple cache dirs (reference disk_cache.go:922)."""
 
-    def __init__(self, dirs: list[str], capacity: int = 1 << 30):
-        self._stores = [DiskCache(d, capacity // max(len(dirs), 1)) for d in dirs]
+    def __init__(self, dirs: list[str], capacity: int = 1 << 30,
+                 checksum: bool = True):
+        self._stores = [
+            DiskCache(d, capacity // max(len(dirs), 1), checksum=checksum)
+            for d in dirs
+        ]
 
     def _pick(self, key: str) -> DiskCache:
         return self._stores[zlib.crc32(key.encode()) % len(self._stores)]
@@ -219,3 +304,7 @@ class CacheManager:
             n += a
             used += b
         return n, used
+
+    def close(self):
+        for s in self._stores:
+            s.close()

@@ -139,6 +139,7 @@ def test_staging_recovery(tmp_path):
     data = os.urandom(65536)
     key = block_key(29, 0, 65536)
     dc.stage(key, data)
+    dc.close()  # "crash": the kernel would release the dir flock
     store = CachedStore(
         storage,
         ChunkConfig(block_size=1 << 16, cache_dirs=(str(cache_dir),), writeback=True),
@@ -257,3 +258,61 @@ def test_multi_block_read_parallel():
     assert got == data
     serial = NBLOCKS * DELAY
     assert wall < serial / 2, f"read took {wall:.3f}s, serial would be {serial:.3f}s"
+
+
+def test_disk_cache_checksum_detects_bitrot(tmp_path):
+    """Checksum-on-read (reference disk_cache.go option): a flipped byte
+    in a cached file becomes a miss + self-heal, never a corrupt read."""
+    from juicefs_tpu.chunk.disk_cache import DiskCache
+
+    dc = DiskCache(str(tmp_path / "c"), checksum=True)
+    data = os.urandom(50_000)
+    dc.cache("chunks/0/0/1_0_50000", data)
+    assert dc.load("chunks/0/0/1_0_50000") == data
+
+    # flip one byte on disk
+    path = dc._raw_path("chunks/0/0/1_0_50000")
+    with open(path, "r+b") as f:
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert dc.load("chunks/0/0/1_0_50000") is None  # detected, dropped
+    assert not os.path.exists(path)  # self-healed (evicted)
+    # re-cache works
+    dc.cache("chunks/0/0/1_0_50000", data)
+    assert dc.load("chunks/0/0/1_0_50000") == data
+
+
+def test_disk_cache_dir_lock_liveness(tmp_path):
+    """Two processes must not share one cache dir (reference
+    disk_cache.go:157-198 lock-file): the second opener fails fast."""
+    import subprocess
+    import sys
+
+    from juicefs_tpu.chunk.disk_cache import DiskCache
+
+    d = str(tmp_path / "c")
+    dc = DiskCache(d)
+    # same-process double-open also refuses (flock is per-fd)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from juicefs_tpu.chunk.disk_cache import DiskCache; "
+         f"DiskCache({d!r}, lock_timeout=0)"],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert out.returncode != 0
+    assert "in use by another process" in out.stderr
+
+
+def test_staged_block_readable_and_uploaded_with_checksum(tmp_path):
+    from juicefs_tpu.chunk.disk_cache import DiskCache
+
+    dc = DiskCache(str(tmp_path / "c"), checksum=True)
+    data = os.urandom(10_000)
+    path = dc.stage("chunks/0/0/2_0_10000", data)
+    assert path and open(path, "rb").read() == data  # staging stays raw
+    assert dc.load("chunks/0/0/2_0_10000") == data   # served pre-upload
+    dc.uploaded("chunks/0/0/2_0_10000", len(data))
+    assert dc.load("chunks/0/0/2_0_10000") == data   # now in raw/ + trailer
+    assert not os.path.exists(path)
