@@ -218,6 +218,25 @@ class CachedStore:
             time.sleep(0.01)
         raise TimeoutError("writeback uploads did not drain")
 
+    def release_cache_locks(self) -> None:
+        """Release per-dir cache locks so a successor process can adopt
+        the cache directories (seamless upgrade hands them over while the
+        predecessor is still tearing down)."""
+        close = getattr(self.cache, "close", None)
+        if close is not None:
+            close()
+
+    def close(self) -> None:
+        """Orderly shutdown: drain uploads, stop workers, free dir locks."""
+        self._pool.shutdown(wait=True)
+        self._rpool.shutdown(wait=False)
+        if self.indexer is not None:
+            try:
+                self.indexer.close()
+            except Exception:
+                pass
+        self.release_cache_locks()
+
     # -- writeback recovery ------------------------------------------------
     def _recover_staging(self) -> None:
         """Re-upload blocks staged before a crash

@@ -79,6 +79,22 @@ class DiskCache:
                 )
         os.ftruncate(self._lockfd, 0)
         os.pwrite(self._lockfd, str(os.getpid()).encode(), 0)
+        # The checksum mode is a property of the DIRECTORY, not the opener:
+        # serving trailered entries without verification (or vice versa)
+        # corrupts reads, and a raw payload can't be sniffed reliably.
+        marker = os.path.join(self.dir, ".checksum")
+        try:
+            with open(marker) as f:
+                persisted = f.read().strip() == "1"
+            if persisted != self.checksum:
+                logger.warning(
+                    "cache dir %s was created with checksum=%s; honoring it",
+                    self.dir, persisted,
+                )
+                self.checksum = persisted
+        except FileNotFoundError:
+            with open(marker, "w") as f:
+                f.write("1" if self.checksum else "0")
 
     def _scan_existing(self) -> None:
         for dirpath, _, filenames in os.walk(self._raw):
@@ -219,11 +235,12 @@ class DiskCache:
         spath = self._stage_path(key)
         try:
             if self.checksum:
-                with open(spath, "rb") as f:
+                # append the trailer in place, then atomically rename: the
+                # staged copy survives any failure (a partial trailer just
+                # fails verification and refetches), and no block rewrite
+                with open(spath, "r+b") as f:
                     data = f.read()
-                os.unlink(spath)
-                self.cache(key, data)
-                return
+                    f.write(_TRAILER.pack(_MAGIC, zlib.crc32(data)))
             rpath = self._raw_path(key)
             os.makedirs(os.path.dirname(rpath), exist_ok=True)
             os.replace(spath, rpath)

@@ -12,6 +12,7 @@ cmd/mount.go:387 NewReloadableStorage), and assemble the chunk store/VFS.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from ..chunk import CachedStore, ChunkConfig
@@ -130,5 +131,39 @@ def main(argv: list[str] | None = None) -> int:
         return 1
 
 
+def fstab_shim(argv: list[str]) -> list[str]:
+    """Translate mount(8) helper arguments into `mount` command args
+    (reference cmd/main.go:107-121: /sbin/mount.juicefs shim).
+
+    mount(8) invokes: mount.juicefs SPEC DIR [-sfnv] [-o opt1,opt2...]
+    """
+    spec, mountpoint = argv[0], argv[1]
+    out = ["mount", spec, mountpoint]
+    it = iter(argv[2:])
+    for a in it:
+        if a == "-o":
+            for opt in next(it, "").split(","):
+                if not opt or opt in ("rw", "defaults", "auto", "noauto",
+                                      "user", "nouser", "exec", "noexec",
+                                      "suid", "nosuid", "dev", "nodev",
+                                      "_netdev"):
+                    continue
+                if opt == "ro":
+                    out.append("--readonly")
+                elif opt == "background":
+                    out.append("-d")
+                elif "=" in opt:
+                    k, v = opt.split("=", 1)
+                    out += [f"--{k.replace('_', '-')}", v]
+                else:
+                    out.append(f"--{opt.replace('_', '-')}")
+        # -s/-f/-n/-v from mount(8) have no meaning here: ignore
+    if "-d" not in out:
+        out.append("-d")  # fstab mounts must daemonize
+    return out
+
+
 def cli_entry() -> None:
+    if os.path.basename(sys.argv[0]).startswith("mount.") and len(sys.argv) >= 3:
+        sys.exit(main(fstab_shim(sys.argv[1:])))
     sys.exit(main())

@@ -145,11 +145,10 @@ def serve(args) -> int:
             logger.info("handover complete; exiting without unmount")
             m.sid = 0  # close_session must not clean the live session
         vfs.close()
-        if store.indexer is not None:
-            try:
-                store.indexer.close()
-            except Exception as e:
-                logger.warning("content indexer drain on unmount: %s", e)
+        try:
+            store.close()
+        except Exception as e:
+            logger.warning("store shutdown: %s", e)
         m.close_session()
     return 0
 

@@ -263,6 +263,9 @@ class Server:
         st = self.vfs.flush_all()
         if st:
             raise IOError(f"flush before handover failed: errno {st}")
+        # all data is durable now: free the cache-dir locks so the
+        # successor's store build doesn't wait out our teardown
+        self.vfs.store.release_cache_locks()
         state = {
             "sid": getattr(self.vfs.meta, "sid", 0),
             "handles": self.vfs.dump_handles(),

@@ -86,6 +86,19 @@ class VFS:
             "Operation latencies (reference vfs/accesslog.go:30-46)",
             ("method",),
         )
+        # memory accounting (reference vfs.go:1276-1315 buffer gauges +
+        # pkg/utils/alloc.go): scraped via /metrics and `juicefs stats`
+        reg = global_registry()
+        reg.gauge(
+            "juicefs_used_buffer_size_bytes",
+            "Bytes in un-uploaded write buffers",
+        ).set_function(self.writer.buffered_bytes)
+        reg.gauge(
+            "juicefs_blockcache_bytes", "Bytes in the local block cache"
+        ).set_function(lambda: self.store.cache.stats()[1])
+        reg.gauge(
+            "juicefs_blockcache_blocks", "Blocks in the local block cache"
+        ).set_function(lambda: self.store.cache.stats()[0])
         self._instrument()
 
     def _instrument(self) -> None:

@@ -241,6 +241,21 @@ class DataWriter:
         )
         self._flusher.start()
 
+    def buffered_bytes(self) -> int:
+        """Bytes currently held in un-uploaded write buffers — the memory
+        accounting the reference keeps in pkg/utils/alloc.go + the
+        used_buffer_size_bytes gauge (vfs.go:1290)."""
+        total = 0
+        with self._lock:
+            writers = list(self._files.values())
+        for fw in writers:
+            with fw.lock:
+                for cw in fw.chunks.values():
+                    for sw in cw.slices:
+                        for buf in sw.ws._blocks.values():
+                            total += len(buf)
+        return total
+
     def open(self, ino: int, length: int) -> FileWriter:
         with self._lock:
             fw = self._files.get(ino)

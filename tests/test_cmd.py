@@ -413,3 +413,19 @@ def test_version_gating_refuses_newer_volume(vol):
     assert m.init(fmt, force=True) == 0
     with pytest.raises(RuntimeError, match="newer than this client"):
         open_meta(meta_url)
+
+
+def test_fstab_shim_translation():
+    """mount(8) helper argv translates to the mount command (reference
+    /sbin/mount.juicefs shim, cmd/main.go:107-121)."""
+    from juicefs_tpu.cmd import fstab_shim
+
+    out = fstab_shim(["sqlite3:///m.db", "/mnt/jfs", "-o",
+                      "ro,defaults,cache-size=512,writeback,_netdev"])
+    assert out[:3] == ["mount", "sqlite3:///m.db", "/mnt/jfs"]
+    assert "--readonly" in out
+    assert ["--cache-size", "512"] == out[out.index("--cache-size"):
+                                          out.index("--cache-size") + 2]
+    assert "--writeback" in out
+    assert "-d" in out  # fstab mounts daemonize
+    assert "--defaults" not in out and "--_netdev" not in out

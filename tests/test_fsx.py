@@ -1,0 +1,163 @@
+"""fsx-style randomized data exerciser (reference fstests/Makefile:11-16
+runs fsx from secfs.test): random pwrite/pread/truncate/fallocate-zero
+sequences against the VFS, cross-checked byte-for-byte against an
+in-memory model file after every op. Catches offset math, slice overlay,
+truncate-extend zeroing, and cache coherence bugs that example-based
+tests miss."""
+
+import errno
+import os
+import random
+
+import pytest
+
+from juicefs_tpu.chunk import CachedStore, ChunkConfig
+from juicefs_tpu.meta import Format, new_client
+from juicefs_tpu.meta.context import Context
+from juicefs_tpu.meta.types import SET_ATTR_SIZE, Attr
+from juicefs_tpu.object import create_storage
+from juicefs_tpu.vfs import ROOT_INO, VFS
+
+CTX = Context(uid=0, gid=0, pid=1)
+MAX_SIZE = 3 << 20  # spans multiple 256 KiB blocks and slice overlays
+N_OPS = 300
+
+
+@pytest.mark.parametrize("seed", [3, 77, 2026])
+def test_fsx_random_data_ops(tmp_path, seed):
+    m = new_client("mem://")
+    m.init(Format(name="fsx", trash_days=0), force=False)
+    m.new_session()
+    store = CachedStore(
+        create_storage("mem://"),
+        ChunkConfig(block_size=1 << 18, cache_dirs=(str(tmp_path / "c"),)),
+    )
+    v = VFS(m, store)
+    rng = random.Random(seed)
+
+    st, ino, _, fh = v.create(CTX, ROOT_INO, b"fsx.dat", 0o644)
+    assert st == 0
+    model = bytearray()
+
+    def vfs_size():
+        st, attr = v.getattr(CTX, ino)
+        assert st == 0
+        return attr.length
+
+    for opno in range(N_OPS):
+        op = rng.choice(["write", "write", "write", "read", "read",
+                         "truncate", "reopen", "flush"])
+        if op == "write":
+            off = rng.randrange(0, MAX_SIZE)
+            n = rng.randrange(1, min(MAX_SIZE - off, 200_000) + 1)
+            data = bytes([rng.randrange(256)]) * n
+            assert v.write(CTX, ino, fh, off, data) == 0
+            if off > len(model):
+                model.extend(b"\x00" * (off - len(model)))
+            model[off:off + n] = data
+        elif op == "read":
+            off = rng.randrange(0, MAX_SIZE)
+            n = rng.randrange(1, 300_000)
+            st, got = v.read(CTX, ino, fh, off, n)
+            assert st == 0, f"op {opno}: read errno {st}"
+            want = bytes(model[off:off + n])
+            assert got == want, (
+                f"op {opno} seed {seed}: read({off},{n}) mismatch "
+                f"(got {len(got)}B, want {len(want)}B)"
+            )
+        elif op == "truncate":
+            length = rng.randrange(0, MAX_SIZE)
+            st, _ = v.setattr(CTX, ino, SET_ATTR_SIZE, Attr(length=length))
+            assert st == 0
+            if length <= len(model):
+                del model[length:]
+            else:
+                model.extend(b"\x00" * (length - len(model)))
+        elif op == "reopen":
+            assert v.flush(CTX, ino, fh) == 0
+            assert v.release(CTX, ino, fh) == 0
+            st, _attr, fh = v.open(CTX, ino, os.O_RDWR)
+            assert st == 0
+        elif op == "flush":
+            assert v.flush(CTX, ino, fh) == 0
+        assert vfs_size() == len(model), f"op {opno}: size diverged"
+
+    # final byte-for-byte sweep
+    assert v.flush(CTX, ino, fh) == 0
+    st, data = v.read(CTX, ino, fh, 0, MAX_SIZE + 1)
+    assert st == 0 and data == bytes(model)
+    v.release(CTX, ino, fh)
+    v.close()
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/dev/fuse"), reason="FUSE not available"
+)
+def test_fsx_through_kernel(tmp_path):
+    """Short fsx run over a real kernel mount: page cache + writeback +
+    FUSE channel all in the loop."""
+    import shutil
+    import time
+
+    if shutil.which("fusermount") is None:
+        pytest.skip("fusermount missing")
+    from juicefs_tpu.fuse import Server
+
+    m = new_client("mem://")
+    m.init(Format(name="fsxk", trash_days=0), force=False)
+    m.new_session()
+    store = CachedStore(
+        create_storage("mem://"),
+        ChunkConfig(block_size=1 << 18, cache_dirs=(str(tmp_path / "c"),)),
+    )
+    v = VFS(m, store)
+    mp = tmp_path / "mnt"
+    mp.mkdir()
+    srv = Server(v, str(mp))
+    try:
+        srv.serve_background()
+    except OSError as e:
+        pytest.skip(f"cannot mount: {e}")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            os.statvfs(mp)
+            break
+        except OSError:
+            time.sleep(0.05)
+    rng = random.Random(11)
+    path = str(mp / "fsx.dat")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    model = bytearray()
+    try:
+        for opno in range(150):
+            op = rng.choice(["write", "write", "read", "truncate", "fsync"])
+            if op == "write":
+                off = rng.randrange(0, 1 << 20)
+                n = rng.randrange(1, 100_000)
+                data = os.urandom(n)
+                os.pwrite(fd, data, off)
+                if off > len(model):
+                    model.extend(b"\x00" * (off - len(model)))
+                model[off:off + n] = data
+            elif op == "read":
+                off = rng.randrange(0, 1 << 20)
+                n = rng.randrange(1, 150_000)
+                got = os.pread(fd, n, off)
+                assert got == bytes(model[off:off + n]), f"op {opno}"
+            elif op == "truncate":
+                length = rng.randrange(0, 1 << 20)
+                os.ftruncate(fd, length)
+                if length <= len(model):
+                    del model[length:]
+                else:
+                    model.extend(b"\x00" * (length - len(model)))
+            else:
+                os.fsync(fd)
+            assert os.fstat(fd).st_size == len(model), f"op {opno}: size"
+        os.fsync(fd)
+        assert os.pread(fd, len(model) + 10, 0) == bytes(model)
+    finally:
+        os.close(fd)
+        srv.unmount()
+        v.close()
