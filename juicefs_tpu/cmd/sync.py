@@ -57,6 +57,9 @@ def add_parser(sub):
                    help="MiB; objects at least this big copy via ranged "
                         "multipart parts (reference sync.go:440)")
     p.add_argument("--part-size", type=int, default=8, help="MiB per part")
+    p.add_argument("--bwlimit", type=int, default=0,
+                   help="aggregate copy bandwidth cap in Mbps (0=unlimited; "
+                        "reference sync.go bwlimit token bucket)")
     # cluster mode (reference cluster.go)
     p.add_argument("--manager-listen", default="",
                    help="host:port — serve the diff as an HTTP task queue "
@@ -122,6 +125,33 @@ def _content_equal(src, dst, key: str, size: int) -> bool:
     return True
 
 
+class _TokenBucket:
+    """Aggregate bandwidth cap shared by all copy workers
+    (reference pkg/sync bwlimit via juju/ratelimit)."""
+
+    def __init__(self, mbps: int):
+        self.rate = mbps * 125_000  # bytes/s
+        self._avail = float(self.rate)  # 1s burst
+        self._last = time.monotonic()
+        self._mu = threading.Lock()
+
+    def take(self, nbytes: int) -> None:
+        while nbytes > 0:
+            with self._mu:
+                now = time.monotonic()
+                self._avail = min(
+                    float(self.rate), self._avail + (now - self._last) * self.rate
+                )
+                self._last = now
+                grant = min(nbytes, self._avail)
+                self._avail -= grant
+                nbytes -= int(grant)
+                if nbytes <= 0:
+                    return
+                wait = nbytes / self.rate
+            time.sleep(min(wait, 0.5))
+
+
 def _copy_object(src, dst, obj, args, stats) -> None:
     """Move one object; big objects go part-by-part through a fixed buffer
     (reference copyData sync.go:440-587 single-PUT vs UploadPart split)."""
@@ -162,6 +192,7 @@ def _copy_object(src, dst, obj, args, stats) -> None:
 
 def _make_executor(src, dst, args, stats):
     """The per-task state machine shared by local and worker modes."""
+    bucket = _TokenBucket(args.bwlimit) if getattr(args, "bwlimit", 0) else None
 
     def do(task):
         op, s, d = task
@@ -170,6 +201,8 @@ def _make_executor(src, dst, args, stats):
                 if args.dry:
                     stats["copied"] += 1
                 else:
+                    if bucket is not None:
+                        bucket.take(s.size)
                     _copy_object(src, dst, s, args, stats)
                     stats["copied"] += 1
                     if args.check_new and not _content_equal(
@@ -340,6 +373,8 @@ def run_manager(args, tasks) -> int:
             flags.append("--" + f.replace("_", "-"))
     flags += ["--big-threshold", str(args.big_threshold),
               "--part-size", str(args.part_size)]
+    if args.bwlimit:
+        flags += ["--bwlimit", str(args.bwlimit)]  # per-worker cap
     print(json.dumps({"manager": addr,
                       "worker_cmd": f"sync {args.src} {args.dst} "
                                     f"{' '.join(flags)} --worker "

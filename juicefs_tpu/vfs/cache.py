@@ -76,11 +76,15 @@ class TTLCache:
 
 
 class MetaCache:
-    """The VFS's attr + dentry caches with mutation invalidation hooks."""
+    """The VFS's attr + dentry + readdir caches with mutation hooks."""
 
-    def __init__(self, attr_ttl: float, entry_ttl: float):
+    def __init__(self, attr_ttl: float, entry_ttl: float,
+                 dir_ttl: float = 0.0):
         self.attrs = TTLCache(attr_ttl)      # ino -> Attr (as stored in meta)
         self.entries = TTLCache(entry_ttl)   # (parent, name) -> ino
+        # (ino, want_attr) -> list[Entry]: full readdir snapshots
+        # (reference pkg/vfs readdir cache / pkg/fs dirStream cache)
+        self.dirs = TTLCache(dir_ttl, maxsize=10_000)
 
     # -- reads -------------------------------------------------------------
     def get_attr(self, ino: int):
@@ -104,8 +108,21 @@ class MetaCache:
         caller can invalidate its attr too, e.g. nlink after unlink)."""
         ino = self.entries.get((parent, name))
         self.entries.invalidate((parent, name))
+        self.invalidate_dir(parent)
         return ino
+
+    # -- readdir snapshots --------------------------------------------------
+    def get_dir(self, ino: int, want_attr: bool):
+        return self.dirs.get((ino, want_attr))
+
+    def put_dir(self, ino: int, want_attr: bool, entries) -> None:
+        self.dirs.put((ino, want_attr), entries)
+
+    def invalidate_dir(self, ino: int) -> None:
+        self.dirs.invalidate((ino, False))
+        self.dirs.invalidate((ino, True))
 
     def clear(self) -> None:
         self.attrs.clear()
         self.entries.clear()
+        self.dirs.clear()

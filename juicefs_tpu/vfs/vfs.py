@@ -78,7 +78,8 @@ class VFS:
         self._append_lock = threading.Lock()
         # entry/attr TTL caches (vfs/cache.py): kernel-style caching for
         # every adapter; local mutations invalidate synchronously below
-        self.cache = MetaCache(self.conf.attr_timeout, self.conf.entry_timeout)
+        self.cache = MetaCache(self.conf.attr_timeout, self.conf.entry_timeout,
+                               self.conf.dir_entry_timeout)
         self.accesslog = AccessLogger()
         self.internal = InternalFiles(self)
         self._op_hist = global_registry().histogram(
@@ -228,6 +229,7 @@ class VFS:
         dentry/attr are known exactly; the parent's attr (mtime, nlink for
         mkdir) changed in meta, so drop it."""
         self.cache.invalidate_attr(parent)
+        self.cache.invalidate_dir(parent)
         self.cache.put_entry(parent, name, ino)
         self.cache.put_attr(ino, attr)
 
@@ -323,9 +325,18 @@ class VFS:
         if h is None:
             return _errno.EBADF, []
         if h.children is None or offset == 0:
-            st, entries = self.meta.readdir(ctx, ino, want_attr)
-            if st != 0:
-                return st, []
+            entries = self.cache.get_dir(ino, want_attr)
+            if entries is not None:
+                # snapshot is shared across users: re-check this caller's
+                # read permission (same rule as cached lookups)
+                st = self.meta.access(ctx, ino, 4, self.cache.get_attr(ino))
+                if st != 0:
+                    return st, []
+            else:
+                st, entries = self.meta.readdir(ctx, ino, want_attr)
+                if st != 0:
+                    return st, []
+                self.cache.put_dir(ino, want_attr, entries)
             h.children = entries
         return 0, h.children[offset:]
 

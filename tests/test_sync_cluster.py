@@ -125,3 +125,19 @@ def test_cluster_mode_two_workers(tmp_path):
     # starts after the queue drains may legitimately get zero tasks)
     assert sum(s["copied"] for s in wstats) == len(objs)
     assert all(s["mismatch"] == 0 and s["skipped"] == 0 for s in wstats)
+
+
+def test_bwlimit_throttles_copy(tmp_path, capsys):
+    """--bwlimit caps aggregate copy bandwidth (reference sync bwlimit)."""
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    src.mkdir()
+    _fill(str(src), {f"f{i}": os.urandom(512 << 10) for i in range(4)})  # 2 MiB
+    t0 = time.perf_counter()
+    rc = main(["sync", f"file://{src}", f"file://{dst}", "--bwlimit", "8"])
+    elapsed = time.perf_counter() - t0
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["copied"] == 4
+    # 2 MiB at 8 Mbps (1 MB/s) with a 1s burst allowance: >= ~1s
+    assert elapsed >= 0.9, f"bwlimit not applied ({elapsed:.2f}s)"
+    assert _tree(str(dst)) == _tree(str(src))

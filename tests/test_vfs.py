@@ -287,3 +287,40 @@ def test_flush_error_is_sticky(vfs, monkeypatch):
     st2 = vfs.flush(CTX, ino, fh)
     assert st1 != 0 and st2 != 0
     assert vfs.write(CTX, ino, fh, 10, b"more") == st1
+
+
+def test_readdir_cache_invalidation(vfs):
+    """Readdir snapshots are cached (reference pkg/fs dir cache) but local
+    namespace mutations invalidate them synchronously."""
+    st, dino, _ = vfs.mkdir(CTX, ROOT_INO, b"rd", 0o755)
+    st, fh = vfs.opendir(CTX, dino)
+    st, entries = vfs.readdir(CTX, dino, fh, 0)
+    assert st == 0 and {e.name for e in entries} == {b".", b".."}
+    # create through the same VFS: next readdir must see it immediately
+    st, ino, _, ffh = vfs.create(CTX, dino, b"new.txt", 0o644)
+    vfs.release(CTX, ino, ffh)
+    st, fh2 = vfs.opendir(CTX, dino)
+    st, entries = vfs.readdir(CTX, dino, fh2, 0)
+    assert b"new.txt" in {e.name for e in entries}
+    assert vfs.unlink(CTX, dino, b"new.txt") == 0
+    st, fh3 = vfs.opendir(CTX, dino)
+    st, entries = vfs.readdir(CTX, dino, fh3, 0)
+    assert b"new.txt" not in {e.name for e in entries}
+    for h in (fh, fh2, fh3):
+        vfs.releasedir(CTX, h)
+
+
+def test_readdir_cache_permission_recheck(vfs):
+    """A cached readdir snapshot must not leak to a user without read
+    permission on the directory."""
+    import errno as _e
+
+    st, dino, _ = vfs.mkdir(CTX, ROOT_INO, b"priv", 0o700)
+    st, fh = vfs.opendir(CTX, dino)
+    assert vfs.readdir(CTX, dino, fh, 0)[0] == 0  # warms the cache
+    stranger = Context(uid=4444, gid=4444, gids=(4444,), pid=1)
+    st, fh2 = vfs.opendir(stranger, dino)
+    if st == 0:  # opendir may itself deny; both outcomes are correct
+        st, _ = vfs.readdir(stranger, dino, fh2, 0)
+    assert st == _e.EACCES
+    vfs.releasedir(CTX, fh)
