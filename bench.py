@@ -79,7 +79,8 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gib", type=float, default=8.0, help="GiB to scan")
     ap.add_argument("--batch", type=int, default=32, help="blocks per device batch")
-    ap.add_argument("--backend", default="xla", choices=["xla", "pallas", "cpu"])
+    ap.add_argument("--backend", default="xla",
+                    choices=["xla", "pallas", "cpu", "shard"])
     ap.add_argument(
         "--probe-timeout", type=float, default=120.0,
         help="seconds to wait for accelerator backend init before CPU fallback",
@@ -148,6 +149,16 @@ def main() -> int:
             d = _hj.hash_packed_pallas(words, counts, lengths, interpret=False)
             dup, first = dedup_scan_jax(d)
             return d, dup, first
+    elif args.backend == "shard":
+        # SPMD over every visible chip (data x lane mesh): on a v5e-8 this
+        # is the full-pod scan; on one chip it degrades to the xla path.
+        from juicefs_tpu.tpu.sharding import make_mesh, sharded_scan_step
+
+        n_dev = len(jax.devices())
+        mesh = make_mesh(n_data=n_dev, n_lane=1)
+        step = sharded_scan_step(mesh)
+        if args.batch % n_dev:
+            args.batch += n_dev - args.batch % n_dev  # data-axis divisible
     else:
         step = scan_step_jax
 
@@ -174,9 +185,11 @@ def _device_bench(args, jax, step, rng, b, m, batch_bytes) -> int:
     )
 
     # Correctness gate: a transferred batch must match the numpy reference.
+    # (the shard backend needs the batch divisible by the data mesh axis)
+    n_verify = b if args.backend == "shard" else 4
     blocks = [
         rng.integers(0, 256, size=BLOCK_BYTES, dtype=np.uint8).tobytes()
-        for _ in range(4)
+        for _ in range(n_verify)
     ]
     vw, vc, vl = pack_blocks(blocks, pad_lanes=m)
     t0 = time.perf_counter()
