@@ -46,6 +46,38 @@ def functional(store) -> list[str]:
     check("list", any(o.key == key for o in store.list_all("objbench/")))
     store.put(key, b"")
     check("empty object", bytes(store.get(key)) == b"")
+    # multipart API, when the store supports it (reference objbench.go
+    # functional suite covers UploadPart/CompleteUpload). "Unsupported" is
+    # signalled by returning None; a RAISING create is a real failure.
+    up = store.create_multipart_upload(key + ".mp")
+    if up is not None:
+        parts = [
+            store.upload_part(key + ".mp", up.upload_id, i + 1,
+                              bytes([i]) * max(up.min_part_size, 1024))
+            for i in range(3)
+        ]
+        store.complete_upload(key + ".mp", up.upload_id, parts)
+        want = b"".join(
+            bytes([i]) * max(up.min_part_size, 1024) for i in range(3)
+        )
+        check("multipart", bytes(store.get(key + ".mp")) == want)
+        store.delete(key + ".mp")
+        up2 = store.create_multipart_upload(key + ".mp2")
+        part = store.upload_part(key + ".mp2", up2.upload_id, 1, b"x" * 1024)
+        store.abort_upload(key + ".mp2", up2.upload_id)
+        # the abort must actually discard the upload: completing it
+        # afterwards has to fail, and no object may appear
+        try:
+            store.complete_upload(key + ".mp2", up2.upload_id, [part])
+            aborted = False
+        except Exception:
+            aborted = True
+        try:
+            store.get(key + ".mp2")
+            exists = True
+        except Exception:
+            exists = False
+        check("multipart abort", aborted and not exists)
     store.delete(key)
     try:
         store.get(key)

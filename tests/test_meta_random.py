@@ -108,6 +108,25 @@ class Driver:
             st, entries = m.readdir(CTX, self._resolve(dir_idx))
             names = tuple(sorted(e.name for e in entries))
             return (st, names)
+        if kind == "facl":
+            _, dir_idx, name, uid, perm = op
+            from juicefs_tpu.meta import acl
+
+            st, ino, _ = m.lookup(CTX, self._resolve(dir_idx), name)
+            if st != 0:
+                return ("lookup", st)
+            rule = acl.Rule(owner=6, group=4, mask=perm, other=0,
+                            named_users=((uid, perm),))
+            st2 = m.set_facl(CTX, ino, acl.TYPE_ACCESS, rule)
+            st3, back = m.get_facl(CTX, ino, acl.TYPE_ACCESS)
+            return ("facl", st2, st3,
+                    back.named_users if st3 == 0 else None)
+        if kind == "quota":
+            _, dir_idx, limit = op
+            dino = self._resolve(dir_idx)
+            st = m.set_dir_quota(CTX, dino, limit << 20, 1000)
+            rec = m.get_dir_quota(dino)
+            return ("quota", st, rec[0] if rec else None)
         raise AssertionError(kind)
 
     def tree(self, ino=ROOT_INODE) -> dict:
@@ -144,7 +163,7 @@ def gen_ops(seed: int, n: int) -> list:
         kind = rng.choice(
             ["mkdir", "create", "create", "symlink", "unlink", "unlink",
              "rmdir", "rename", "rename", "link", "chmod", "truncate",
-             "xattr", "lookup", "lookup", "readdir"]
+             "xattr", "lookup", "lookup", "readdir", "facl", "quota"]
         )
         di = rng.randrange(16)
         name = rng.choice(NAMES)
@@ -169,6 +188,11 @@ def gen_ops(seed: int, n: int) -> list:
             ops.append(("lookup", di, name))
         elif kind == "readdir":
             ops.append(("readdir", di))
+        elif kind == "facl":
+            ops.append(("facl", di, name, 1000 + rng.randrange(4),
+                        rng.choice([4, 6, 7])))
+        elif kind == "quota":
+            ops.append(("quota", di, rng.randrange(1, 100)))
     return ops
 
 
@@ -185,13 +209,16 @@ def _engines(tmp_path):
     return engines, srv
 
 
-@pytest.mark.parametrize("seed", [7, 1234])
-def test_random_ops_agree_across_engines(tmp_path, seed):
+@pytest.mark.parametrize("seed,trash_days", [(7, 0), (1234, 0), (99, 1)])
+def test_random_ops_agree_across_engines(tmp_path, seed, trash_days):
+    """trash_days=1 runs the same contract with every unlink/rmdir routed
+    through the trash machinery — engines must still agree."""
     engines, srv = _engines(tmp_path)
     try:
         drivers = []
         for name, m in engines:
-            m.init(Format(name=f"rnd", trash_days=0), force=True)
+            m.init(Format(name=f"rnd", trash_days=trash_days,
+                          enable_acl=True), force=True)
             m.load()
             drivers.append((name, Driver(m)))
 

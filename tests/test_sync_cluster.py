@@ -141,3 +141,35 @@ def test_bwlimit_throttles_copy(tmp_path, capsys):
     # 2 MiB at 8 Mbps (1 MB/s) with a 1s burst allowance: >= ~1s
     assert elapsed >= 0.9, f"bwlimit not applied ({elapsed:.2f}s)"
     assert _tree(str(dst)) == _tree(str(src))
+
+
+def test_cross_protocol_sync_s3_to_webdav(tmp_path, capsys):
+    """Sync between two different wire protocols — our S3 gateway as the
+    source, our WebDAV gateway as the destination — proving the object
+    drivers interchange (reference: any-to-any pkg/sync)."""
+    from tests.test_object import _make_s3_env, _make_webdav_env
+
+    gw, v1, s3ep = _make_s3_env(tmp_path)
+    dav, v2, davep = _make_webdav_env(tmp_path)
+    try:
+        from juicefs_tpu.object import create_storage
+
+        src = create_storage(s3ep + "/bkt")
+        src.create()
+        blobs = {f"d/{i}.bin": os.urandom(20_000 + i) for i in range(6)}
+        for k, b in blobs.items():
+            src.put(k, b)
+
+        rc = main(["sync", s3ep + "/bkt", davep, "--check-new"])
+        assert rc == 0
+        stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert stats["copied"] == 6 and stats["mismatch"] == 0
+
+        dst = create_storage(davep)
+        for k, b in blobs.items():
+            assert bytes(dst.get(k)) == b
+    finally:
+        gw.stop()
+        dav.stop()
+        v1.close()
+        v2.close()
