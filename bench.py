@@ -78,7 +78,9 @@ def _pin_cpu_backend() -> None:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gib", type=float, default=8.0, help="GiB to scan")
-    ap.add_argument("--batch", type=int, default=32, help="blocks per device batch")
+    ap.add_argument("--batch", type=int, default=128,
+                    help="blocks per device batch (128 x 4 MiB = 512 MiB "
+                         "resident; measured fastest on v5e)")
     ap.add_argument("--backend", default="xla",
                     choices=["xla", "pallas", "cpu", "shard"])
     ap.add_argument(

@@ -328,3 +328,52 @@ def test_control_file_through_kernel(mnt):
         assert resp["size"] >= 1234
     finally:
         os.close(fd)
+
+
+def test_rename_exchange_through_kernel(mnt):
+    """RENAME_EXCHANGE via renameat2 through the kernel FUSE path."""
+    a, b = os.path.join(mnt, "a"), os.path.join(mnt, "b")
+    with open(a, "wb") as f:
+        f.write(b"AAA")
+    with open(b, "wb") as f:
+        f.write(b"BBB")
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        RENAME_EXCHANGE = 2
+        AT_FDCWD = -100
+        rc = libc.renameat2(AT_FDCWD, a.encode(), AT_FDCWD, b.encode(),
+                            RENAME_EXCHANGE)
+        if rc != 0:
+            err = ctypes.get_errno()
+            pytest.skip(f"renameat2 EXCHANGE unsupported: errno {err}")
+    except AttributeError:
+        pytest.skip("no renameat2 in libc")
+    assert open(a, "rb").read() == b"BBB"
+    assert open(b, "rb").read() == b"AAA"
+
+
+def test_copy_file_range_through_kernel(mnt):
+    """copy_file_range(2) is served by the FUSE COPY_FILE_RANGE op (falls
+    back to read/write in the kernel only if we return ENOSYS)."""
+    src = os.path.join(mnt, "cfr-src")
+    dst = os.path.join(mnt, "cfr-dst")
+    payload = os.urandom(300_000)
+    with open(src, "wb") as f:
+        f.write(payload)
+    sfd = os.open(src, os.O_RDONLY)
+    dfd = os.open(dst, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        copied = 0
+        while copied < len(payload):
+            n = os.copy_file_range(sfd, dfd, len(payload) - copied,
+                                   copied, copied)
+            if n == 0:
+                break
+            copied += n
+        assert copied == len(payload)
+    finally:
+        os.close(sfd)
+        os.close(dfd)
+    assert open(dst, "rb").read() == payload
