@@ -16,14 +16,21 @@ def add_parser(sub):
     )
     p.add_argument("--host", default="0.0.0.0", help="bind address")
     p.add_argument("--port", type=int, default=6389, help="bind port")
+    p.add_argument("--data", default="",
+                   help="append-only file for durability (replayed on "
+                        "start, compacted to a snapshot; empty = memory only)")
+    p.add_argument("--fsync", default="everysec", choices=["always", "everysec"],
+                   help="AOF durability: per-mutation or batched (Redis-style)")
     p.set_defaults(func=run)
 
 
 def run(args) -> int:
     from ..meta.redis_server import RedisServer
 
-    srv = RedisServer(args.host, args.port)
+    srv = RedisServer(args.host, args.port, data_path=args.data or None,
+                      fsync=args.fsync)
     port = srv.start()
-    print(f"meta-server listening on {args.host}:{port}", flush=True)
+    durable = f" (aof={args.data}, fsync={args.fsync})" if args.data else ""
+    print(f"meta-server listening on {args.host}:{port}{durable}", flush=True)
     srv.wait()
     return 0

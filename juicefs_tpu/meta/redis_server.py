@@ -21,6 +21,7 @@ optimistic scheme as Redis WATCH (redis.io/topics/transactions).
 from __future__ import annotations
 
 import bisect
+import os
 import socket
 import socketserver
 import threading
@@ -42,17 +43,183 @@ class _DB:
 
 
 class RedisServer:
-    """Minimal RESP2 server. start() returns the bound port."""
+    """Minimal RESP2 server. start() returns the bound port.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, n_dbs: int = 16):
+    Durability (role-match to Redis AOF): with data_path set, every
+    mutating command is appended to an append-only file (RESP-encoded,
+    replayable by the same parser) and replayed on start; after replay
+    the file is rewritten as a compact snapshot so it never grows
+    unboundedly across restarts. fsync="always" makes every mutation
+    durable before its reply; "everysec" batches fsyncs (Redis's
+    default trade-off).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, n_dbs: int = 16,
+                 data_path: Optional[str] = None, fsync: str = "everysec"):
         self.host, self.port = host, port
         self.dbs = [_DB() for _ in range(n_dbs)]
         self.lock = threading.RLock()
         self._srv: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self.data_path = data_path
+        self.fsync = fsync
+        self._aof = None
+        self._aof_db = -1  # db of the last logged SELECT (-1 = none yet)
+        self._aof_txn = 0  # EXEC nesting: defer fsync to the txn end
+        self._aof_stop = threading.Event()
+
+    # ---- persistence -----------------------------------------------------
+    def aof_append(self, db_idx: int, parts: list) -> None:
+        """Log one mutating command (caller holds self.lock)."""
+        if self._aof is None:
+            if self.data_path and not getattr(self, "_replaying", False):
+                logger.warning("aof closed: mutation not logged (shutdown?)")
+            return
+        buf = b""
+        if db_idx != self._aof_db:
+            buf += _Conn._enc([b"SELECT", str(db_idx).encode()])
+            self._aof_db = db_idx
+        buf += _Conn._enc([p if isinstance(p, bytes) else bytes(p) for p in parts])
+        self._aof.write(buf)
+        if self.fsync == "always" and self._aof_txn == 0:
+            self._aof.flush()
+            os.fsync(self._aof.fileno())
+
+    def aof_txn_begin(self, db_idx: int) -> None:
+        if self._aof is None:
+            return
+        self.aof_append(db_idx, [b"MULTI"])
+        self._aof_txn += 1
+
+    def aof_txn_end(self) -> None:
+        if self._aof is None:
+            return
+        self.aof_append(self._aof_db, [b"EXEC"])  # still in-txn: no fsync yet
+        self._aof_txn -= 1
+        if self.fsync == "always":
+            self._aof.flush()
+            os.fsync(self._aof.fileno())
+
+    def _replay_conn(self) -> "_Conn":
+        conn = object.__new__(_Conn)
+        conn.server = self
+        conn.db = self.dbs[0]
+        conn.db_idx = 0
+        conn.watched = {}
+        conn.in_multi = False
+        conn.queue = []
+        conn.multi_err = False
+        return conn
+
+    def _load_aof(self) -> None:
+        try:
+            f = open(self.data_path, "rb")
+        except FileNotFoundError:
+            return
+        conn = self._replay_conn()
+        n = 0
+        txn_buf: Optional[list] = None  # records between MULTI and EXEC
+
+        def apply(parts) -> None:
+            nonlocal n
+            name = parts[0].upper().decode("ascii", "replace").lower()
+            handler = getattr(conn, "cmd_" + name, None)
+            if handler is not None:
+                handler(parts[1:])
+                n += 1
+
+        with f:
+            while True:
+                try:
+                    line = f.readline()
+                    if not line:
+                        break
+                    if not line.startswith(b"*"):
+                        logger.warning("aof: garbled record; stopping replay")
+                        break
+                    parts = []
+                    for _ in range(int(line[1:])):
+                        hdr = f.readline()
+                        ln = int(hdr[1:])
+                        data = f.read(ln + 2)[:-2]
+                        if len(data) != ln:
+                            raise EOFError
+                        parts.append(data)
+                    if not parts:
+                        raise ValueError("empty record")
+                except Exception:
+                    # torn/garbled tail (crash mid-append): keep the
+                    # consistent prefix, never refuse to boot
+                    logger.warning("aof: torn tail record ignored")
+                    break
+                op = parts[0].upper()
+                if op == b"MULTI":
+                    txn_buf = []
+                elif op == b"EXEC":
+                    for rec in txn_buf or ():
+                        apply(rec)
+                    txn_buf = None
+                elif txn_buf is not None:
+                    txn_buf.append(parts)
+                else:
+                    apply(parts)
+        if txn_buf is not None:
+            # crash mid-transaction: the whole txn is discarded, keeping
+            # metadata invariants (no half-applied mkdir/rename)
+            logger.warning("aof: unterminated transaction of %d records "
+                           "discarded", len(txn_buf))
+        if n:
+            logger.info("aof: replayed %d mutations from %s", n, self.data_path)
+
+    def _rewrite_aof(self) -> None:
+        """Compact the log into a snapshot of current state."""
+        tmp = self.data_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for i, db in enumerate(self.dbs):
+                if not db.data and not db.zsets:
+                    continue
+                f.write(_Conn._enc([b"SELECT", str(i).encode()]))
+                for k, v in db.data.items():
+                    f.write(_Conn._enc([b"SET", k, v]))
+                for name, members in db.zsets.items():
+                    for m in members:
+                        f.write(_Conn._enc([b"ZADD", name, b"0", m]))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.data_path)
+        self._aof = open(self.data_path, "ab")
+        # the snapshot may end in any db: -1 forces the next append to
+        # emit its own SELECT (0 here would mis-route db-0 writes into
+        # whatever db the snapshot finished on at replay time)
+        self._aof_db = -1
+
+    def _fsync_loop(self) -> None:
+        while not self._aof_stop.wait(1.0):
+            fd = -1
+            with self.lock:  # flush the buffered writer under the lock...
+                if self._aof is not None:
+                    self._aof.flush()
+                    fd = self._aof.fileno()
+            if fd >= 0:  # ...but fsync outside it: a slow disk must not
+                try:     # stall every client command for the fsync
+                    os.fsync(fd)
+                except OSError:
+                    pass
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> int:
+        if self.data_path:
+            with self.lock:
+                self._replaying = True
+                try:
+                    self._load_aof()
+                finally:
+                    self._replaying = False
+                self._rewrite_aof()
+            if self.fsync != "always":
+                threading.Thread(
+                    target=self._fsync_loop, name="aof-fsync", daemon=True
+                ).start()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -76,6 +243,16 @@ class RedisServer:
             self._srv.shutdown()
             self._srv.server_close()
             self._srv = None
+        self._aof_stop.set()
+        with self.lock:
+            if self._aof is not None:
+                self._aof.flush()
+                try:
+                    os.fsync(self._aof.fileno())
+                except OSError:
+                    pass
+                self._aof.close()
+                self._aof = None
 
     def wait(self) -> None:
         """Block until the server stops (or interrupt → stop)."""
@@ -102,6 +279,7 @@ class _Conn:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rfile = sock.makefile("rb")
         self.db = server.dbs[0]
+        self.db_idx = 0
         self.watched: dict[bytes, int] = {}
         self.in_multi = False
         self.queue: list[list[bytes]] = []
@@ -187,11 +365,15 @@ class _Conn:
     def cmd_echo(self, args):
         return args[0]
 
+    def _log(self, name: bytes, args) -> None:
+        self.server.aof_append(self.db_idx, [name] + list(args))
+
     def cmd_select(self, args):
         idx = int(args[0])
         if not 0 <= idx < len(self.server.dbs):
             return _Err("ERR DB index is out of range")
         self.db = self.server.dbs[idx]
+        self.db_idx = idx
         return _Status("OK")
 
     def cmd_flushdb(self, args):
@@ -200,6 +382,7 @@ class _Conn:
         # bump everything watched so concurrent txns abort
         for k in list(self.db.versions):
             self.db.bump(k)
+        self._log(b"FLUSHDB", [])
         return _Status("OK")
 
     def cmd_dbsize(self, args):
@@ -214,6 +397,7 @@ class _Conn:
     def cmd_set(self, args):
         self.db.data[args[0]] = args[1]
         self.db.bump(args[0])
+        self._log(b"SET", args[:2])
         return _Status("OK")
 
     def cmd_del(self, args):
@@ -223,6 +407,7 @@ class _Conn:
                 del self.db.data[k]
                 n += 1
             self.db.bump(k)
+        self._log(b"DEL", args)
         return n
 
     def cmd_exists(self, args):
@@ -233,6 +418,8 @@ class _Conn:
         cur += int(args[1])
         self.db.data[args[0]] = str(cur).encode()
         self.db.bump(args[0])
+        # logged as the absolute SET: replay is idempotent
+        self._log(b"SET", [args[0], str(cur).encode()])
         return cur
 
     def cmd_zadd(self, args):
@@ -246,6 +433,7 @@ class _Conn:
                 zs.insert(j, member)
                 added += 1
         self.db.bump(args[0])
+        self._log(b"ZADD", args)
         return added
 
     def cmd_zrem(self, args):
@@ -257,6 +445,7 @@ class _Conn:
                 zs.pop(j)
                 removed += 1
         self.db.bump(args[0])
+        self._log(b"ZREM", args)
         return removed
 
     def cmd_zcard(self, args):
@@ -322,12 +511,20 @@ class _Conn:
                     self.watched.clear()
                     return NIL_ARRAY  # conflict: txn aborted
             self.watched.clear()
-            out = []
-            for q in queue:
-                handler = getattr(self, "cmd_" + q[0].decode().lower(), None)
-                out.append(
-                    handler(q[1:]) if handler else _Err("ERR unknown command")
-                )
+            # AOF atomicity: the queued mutations log between MULTI/EXEC
+            # markers; replay applies them all-or-nothing, so a crash can
+            # never persist half a metadata transaction (Redis AOF wraps
+            # transactions the same way). fsync happens once, after EXEC.
+            self.server.aof_txn_begin(self.db_idx)
+            try:
+                out = []
+                for q in queue:
+                    handler = getattr(self, "cmd_" + q[0].decode().lower(), None)
+                    out.append(
+                        handler(q[1:]) if handler else _Err("ERR unknown command")
+                    )
+            finally:
+                self.server.aof_txn_end()
             return out
 
 
@@ -346,21 +543,14 @@ NIL_ARRAY: list = []
 
 
 def main(argv=None) -> int:
-    import argparse
+    """Delegates to the one canonical arg parser (cmd/meta_server.py) so
+    the two entry points can never drift."""
+    from ..cmd import main as cmd_main
 
-    ap = argparse.ArgumentParser(
-        prog="meta-server",
-        description="serve the bundled Redis-protocol meta transport",
-    )
-    ap.add_argument("--host", default="0.0.0.0")
-    ap.add_argument("--port", type=int, default=6389)
-    a = ap.parse_args(argv)
-    srv = RedisServer(a.host, a.port)
-    port = srv.start()
-    print(f"meta-server listening on {a.host}:{port}")
-    srv.wait()
-    return 0
+    return cmd_main(["meta-server"] + list(argv or []))
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

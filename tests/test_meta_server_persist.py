@@ -1,0 +1,139 @@
+"""Bundled meta-server durability (role-match to Redis AOF/RDB): a
+standalone meta-server restart must not lose the volume. Mutations are
+appended to a replayable log, compacted into a snapshot at startup, and
+a torn tail write (crash mid-append) is tolerated."""
+
+import errno
+import os
+
+import pytest
+
+from juicefs_tpu.meta import Format, new_client, ROOT_INODE
+from juicefs_tpu.meta.context import Context
+from juicefs_tpu.meta.redis_server import RedisServer
+
+CTX = Context(uid=0, gid=0)
+
+
+def test_volume_survives_server_restart(tmp_path):
+    aof = str(tmp_path / "meta.aof")
+
+    srv = RedisServer(data_path=aof, fsync="always")
+    port = srv.start()
+    url = f"redis://127.0.0.1:{port}/0"
+    m = new_client(url)
+    m.init(Format(name="durable", trash_days=0), force=True)
+    m.load()
+    m.new_session()
+    st, dino, _ = m.mkdir(CTX, ROOT_INODE, b"docs", 0o755)
+    st, fino, _ = m.create(CTX, dino, b"a.txt", 0o644)
+    m.close(CTX, fino)
+    assert m.setxattr(CTX, fino, b"user.k", b"v") == 0
+    m.close_session()
+    m.client.close()
+    srv.stop()
+
+    # fresh server process-equivalent: same file, new in-memory state
+    srv2 = RedisServer(data_path=aof, fsync="always")
+    port2 = srv2.start()
+    m2 = new_client(f"redis://127.0.0.1:{port2}/0")
+    fmt = m2.load()
+    assert fmt.name == "durable"
+    st, ino, _ = m2.lookup(CTX, ROOT_INODE, b"docs")
+    assert st == 0 and ino == dino
+    st, ino2, attr = m2.lookup(CTX, dino, b"a.txt")
+    assert st == 0 and ino2 == fino and attr.mode == 0o644
+    st, val = m2.getxattr(CTX, fino, b"user.k")
+    assert st == 0 and bytes(val) == b"v"
+    # the lexicographic scan index survived too (readdir uses it)
+    st, entries = m2.readdir(CTX, dino)
+    assert {e.name for e in entries} >= {b"a.txt"}
+    # and the volume is writable after recovery
+    st, f2, _ = m2.create(CTX, dino, b"b.txt", 0o600)
+    assert st == 0
+    m2.close(CTX, f2)
+    m2.client.close()
+    srv2.stop()
+
+
+def test_torn_tail_write_tolerated(tmp_path):
+    aof = str(tmp_path / "meta.aof")
+    srv = RedisServer(data_path=aof, fsync="always")
+    port = srv.start()
+    m = new_client(f"redis://127.0.0.1:{port}/0")
+    m.init(Format(name="torn", trash_days=0), force=True)
+    m.load()
+    st, dino, _ = m.mkdir(CTX, ROOT_INODE, b"keep", 0o755)
+    m.client.close()
+    srv.stop()
+
+    # simulate a crash mid-append: chop bytes off the tail record
+    with open(aof, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        f.truncate(f.tell() - 7)
+
+    srv2 = RedisServer(data_path=aof)
+    port2 = srv2.start()
+    m2 = new_client(f"redis://127.0.0.1:{port2}/0")
+    m2.load()  # volume header intact
+    # everything before the torn record is present and consistent
+    st, entries = m2.readdir(CTX, ROOT_INODE)
+    assert st == 0
+    m2.client.close()
+    srv2.stop()
+
+
+def test_snapshot_compaction_bounds_growth(tmp_path):
+    aof = str(tmp_path / "meta.aof")
+    srv = RedisServer(data_path=aof, fsync="always")
+    port = srv.start()
+    m = new_client(f"redis://127.0.0.1:{port}/0")
+    m.init(Format(name="compact", trash_days=0), force=True)
+    m.load()
+    m.new_session()
+    # churn: create + delete many times -> log >> live state
+    for i in range(50):
+        st, ino, _ = m.create(CTX, ROOT_INODE, b"churn", 0o644)
+        m.close(CTX, ino)
+        assert m.unlink(CTX, ROOT_INODE, b"churn") == 0
+    m.close_session()
+    m.client.close()
+    srv.stop()
+    churned = os.path.getsize(aof)
+
+    # restart compacts the log into a snapshot of live state
+    srv2 = RedisServer(data_path=aof)
+    srv2.start()
+    srv2.stop()
+    compacted = os.path.getsize(aof)
+    assert compacted < churned / 2, (churned, compacted)
+
+
+def test_unterminated_txn_discarded_on_replay(tmp_path):
+    """A crash between a transaction's records must not replay half of it
+    (metadata invariants: no orphan inode without its dentry)."""
+    aof = str(tmp_path / "meta.aof")
+    srv = RedisServer(data_path=aof, fsync="always")
+    port = srv.start()
+    m = new_client(f"redis://127.0.0.1:{port}/0")
+    m.init(Format(name="atomic", trash_days=0), force=True)
+    m.load()
+    m.client.txn(lambda tx: tx.set(b"committed", b"yes"))
+    m.client.close()
+    srv.stop()
+
+    # append a MULTI + one record with NO terminating EXEC (crash point)
+    from juicefs_tpu.meta.redis_server import _Conn
+
+    with open(aof, "ab") as f:
+        f.write(_Conn._enc([b"SELECT", b"0"]))
+        f.write(_Conn._enc([b"MULTI"]))
+        f.write(_Conn._enc([b"SET", b"half-applied", b"poison"]))
+
+    srv2 = RedisServer(data_path=aof)
+    port2 = srv2.start()
+    m2 = new_client(f"redis://127.0.0.1:{port2}/0")
+    assert m2.client.execute(b"GET", b"committed") == b"yes"
+    assert m2.client.execute(b"GET", b"half-applied") is None  # discarded
+    m2.client.close()
+    srv2.stop()
