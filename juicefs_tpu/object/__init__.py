@@ -56,11 +56,26 @@ def _webdav_factory(addr: str) -> ObjectStorage:
     return WebDAVStorage(addr)
 
 
+def _sqlite_factory(addr: str) -> ObjectStorage:
+    from .dbstore import SqliteStorage
+
+    return SqliteStorage(addr)
+
+
+def _redis_obj_factory(addr: str) -> ObjectStorage:
+    from .dbstore import RedisStorage
+
+    return RedisStorage(addr)
+
+
 register("file", lambda addr: FileStorage(addr))
 register("mem", lambda addr: MemStorage(addr))
 register("s3", _s3_factory)
 register("minio", _s3_factory)
 register("webdav", _webdav_factory)
+register("sqlite3", _sqlite_factory)
+register("sqlite", _sqlite_factory)
+register("redis", _redis_obj_factory)
 
 __all__ = [
     "Obj",

@@ -78,9 +78,24 @@ def _make_webdav_env(tmp_path):
 
 @pytest.fixture(params=[
     "mem", "file", "prefix", "sharded", "checksum", "encrypted", "enc+sum",
-    "s3", "webdav",
+    "s3", "webdav", "sqlite", "redisobj",
 ])
 def store(request, tmp_path):
+    if request.param == "sqlite":
+        s = create_storage(f"sqlite3://{tmp_path}/objs.db")
+        s.create()
+        yield s
+        return
+    if request.param == "redisobj":
+        from juicefs_tpu.meta.redis_server import RedisServer
+
+        srv = RedisServer()
+        port = srv.start()
+        s = create_storage(f"redis://127.0.0.1:{port}/1")
+        s.create()
+        yield s
+        srv.stop()
+        return
     if request.param == "s3":
         gw, v, ep = _make_s3_env(tmp_path)
         s = create_storage(ep + "/bkt")
