@@ -22,10 +22,14 @@ class SqliteStorage(ObjectStorage):
     """Objects in a sqlite table (reference pkg/object/sqlite.go)."""
 
     def __init__(self, addr: str):
-        self.path = addr or ":memory:"
-        if self.path != ":memory:":
-            d = os.path.dirname(os.path.abspath(self.path))
-            os.makedirs(d, exist_ok=True)
+        if not addr or addr == ":memory:":
+            # thread-local connections would each get a private empty
+            # :memory: database; use mem:// for an in-memory store
+            raise ValueError("sqlite3:// needs a file path (use mem:// "
+                             "for an in-memory object store)")
+        self.path = addr
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
         self._local = threading.local()
         conn = self._conn()
         conn.execute(
@@ -51,15 +55,22 @@ class SqliteStorage(ObjectStorage):
         pass
 
     def get(self, key: str, off: int = 0, limit: int = -1) -> bytes:
-        row = self._conn().execute(
-            "SELECT v FROM objs WHERE k = ?", (key,)
-        ).fetchone()
+        # ranged reads slice inside sqlite (substr is 1-based): a few-KB
+        # page read must not copy the whole 4 MiB blob out first
+        if off or limit >= 0:
+            n = -1 if limit < 0 else limit
+            row = self._conn().execute(
+                "SELECT substr(v, ?, CASE WHEN ? < 0 THEN length(v) "
+                "ELSE ? END) FROM objs WHERE k = ?",
+                (off + 1, n, n, key),
+            ).fetchone()
+        else:
+            row = self._conn().execute(
+                "SELECT v FROM objs WHERE k = ?", (key,)
+            ).fetchone()
         if row is None:
             raise NotFoundError(key)
-        data = bytes(row[0])
-        if off or limit >= 0:
-            return data[off:] if limit < 0 else data[off:off + limit]
-        return data
+        return bytes(row[0])
 
     def put(self, key: str, data: bytes) -> None:
         conn = self._conn()
@@ -84,12 +95,17 @@ class SqliteStorage(ObjectStorage):
         return Obj(key=key, size=row[0], mtime=row[1])
 
     def list_all(self, prefix: str = "", marker: str = "") -> Iterator[Obj]:
+        # plain key-range scan + exact startswith: LIKE would treat _/% as
+        # wildcards and compare case-insensitively (block keys contain '_')
+        lo, op = (marker, ">") if marker else (prefix, ">=")
         for k, size, mtime in self._conn().execute(
-            "SELECT k, length(v), mtime FROM objs "
-            "WHERE k >= ? AND (? = '' OR k LIKE ? || '%') AND k > ? "
-            "ORDER BY k",
-            (prefix, prefix, prefix, marker),
+            f"SELECT k, length(v), mtime FROM objs WHERE k {op} ? ORDER BY k",
+            (lo,),
         ):
+            if prefix and not k.startswith(prefix):
+                if k > prefix:
+                    break  # sorted: past the prefix range
+                continue
             yield Obj(key=k, size=size, mtime=mtime)
 
 
@@ -123,25 +139,47 @@ class RedisStorage(ObjectStorage):
             return data[off:] if limit < 0 else data[off:off + limit]
         return bytes(data)
 
+    def _pipeline(self, *cmds: tuple) -> list:
+        """MULTI/EXEC pipeline: crash/network loss mid-put must never
+        leave a block stored but missing from the listing index (gc/fsck
+        enumerate via the index — an unindexed block would leak forever)."""
+
+        def run():
+            conn = self._kv._conn()
+            conn.send((b"MULTI",), *cmds, (b"EXEC",))
+            replies = [conn.read_reply() for _ in range(len(cmds) + 2)]
+            return replies[-1]
+
+        return self._kv._retry_io(run)
+
     def put(self, key: str, data: bytes) -> None:
         k = key.encode()
-        self._kv.execute(b"SET", self.PREFIX + k, bytes(data))
-        self._kv.execute(b"SET", self.META + k, repr(time.time()).encode())
-        self._kv.execute(b"ZADD", self.IDX, b"0", k)
+        meta = f"{len(data)}:{time.time()}".encode()
+        self._pipeline(
+            (b"SET", self.PREFIX + k, bytes(data)),
+            (b"SET", self.META + k, meta),
+            (b"ZADD", self.IDX, b"0", k),
+        )
 
     def delete(self, key: str) -> None:
         k = key.encode()
-        self._kv.execute(b"DEL", self.PREFIX + k, self.META + k)
-        self._kv.execute(b"ZREM", self.IDX, k)
+        self._pipeline(
+            (b"DEL", self.PREFIX + k, self.META + k),
+            (b"ZREM", self.IDX, k),
+        )
 
     def head(self, key: str) -> Obj:
+        # size+mtime live in the small objm: record — head and listings
+        # must not GET multi-MiB bodies just to report sizes
         k = key.encode()
-        data = self._kv.execute(b"GET", self.PREFIX + k)
-        if data is None:
+        raw = self._kv.execute(b"GET", self.META + k)
+        if raw is None:
+            if self._kv.execute(b"EXISTS", self.PREFIX + k):
+                data = self._kv.execute(b"GET", self.PREFIX + k)
+                return Obj(key=key, size=len(data or b""), mtime=0.0)
             raise NotFoundError(key)
-        raw_m = self._kv.execute(b"GET", self.META + k)
-        mtime = float(raw_m) if raw_m else 0.0
-        return Obj(key=key, size=len(data), mtime=mtime)
+        size_s, _, mtime_s = bytes(raw).partition(b":")
+        return Obj(key=key, size=int(size_s), mtime=float(mtime_s or 0))
 
     def list_all(self, prefix: str = "", marker: str = "") -> Iterator[Obj]:
         lo = b"[" + (marker or prefix).encode() if (marker or prefix) else b"-"
@@ -160,7 +198,7 @@ class RedisStorage(ObjectStorage):
                 if marker and ks <= marker:
                     continue
                 if prefix and not ks.startswith(prefix):
-                    if ks > prefix and not ks.startswith(prefix):
+                    if ks > prefix:
                         return  # sorted: past the prefix range
                     continue
                 try:
