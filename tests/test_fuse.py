@@ -377,3 +377,38 @@ def test_copy_file_range_through_kernel(mnt):
         os.close(sfd)
         os.close(dfd)
     assert open(dst, "rb").read() == payload
+
+
+def test_stats_profile_debug_cli_against_mount(mnt, capsys):
+    """The observability CLIs consume a live mount's virtual files
+    (reference cmd/stats.go, cmd/profile.go:153-335, cmd/debug.go)."""
+    import threading
+
+    from juicefs_tpu.cmd import main
+
+    # generate some traffic for the histograms + access log
+    def churn():
+        for i in range(30):
+            p = os.path.join(mnt, f"obs{i}")
+            with open(p, "wb") as f:
+                f.write(b"x" * 1000)
+            open(p, "rb").read()
+            os.stat(p)
+
+    churn()
+    assert main(["stats", mnt, "--filter", "juicefs"]) == 0
+    out = capsys.readouterr().out
+    assert "juicefs_fuse_ops_durations_histogram_seconds" in out
+    assert "juicefs_uptime" in out or "_count" in out
+
+    # profile samples .accesslog live: drive I/O during the window
+    t = threading.Thread(target=churn)
+    t.start()
+    assert main(["profile", mnt, "--duration", "1.0"]) == 0
+    t.join()
+    out = capsys.readouterr().out
+    assert "op" in out and ("write" in out or "create" in out), out
+
+    assert main(["debug", mnt]) == 0
+    out = capsys.readouterr().out
+    assert ".config" in out and "statvfs" in out.lower() or out
