@@ -77,7 +77,10 @@ def _pin_cpu_backend() -> None:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--gib", type=float, default=8.0, help="GiB to scan")
+    ap.add_argument("--gib", type=float, default=32.0,
+                    help="GiB to scan (one fused device program; large "
+                         "enough to amortize the ~100ms per-dispatch relay "
+                         "latency of this dev harness)")
     ap.add_argument("--batch", type=int, default=128,
                     help="blocks per device batch (128 x 4 MiB = 512 MiB "
                          "resident; measured fastest on v5e)")
@@ -133,6 +136,9 @@ def main() -> int:
 
     from juicefs_tpu.tpu.dedup import dedup_scan_jax, scan_step_jax
 
+    import jax.numpy as jnp
+    from jax import lax
+
     if args.backend == "pallas":
         from juicefs_tpu.tpu import hash_jax as _hj
 
@@ -146,15 +152,18 @@ def main() -> int:
             }))
             return 1
 
+        def hash_fn(w, c, ln):
+            return _hj.hash_packed_pallas(w, c, ln, interpret=False)
+
         @jax.jit
         def step(words, counts, lengths):
-            d = _hj.hash_packed_pallas(words, counts, lengths, interpret=False)
+            d = hash_fn(words, counts, lengths)
             dup, first = dedup_scan_jax(d)
             return d, dup, first
     elif args.backend == "shard":
         # SPMD over every visible chip (data x lane mesh): on a v5e-8 this
         # is the full-pod scan; on one chip it degrades to the xla path.
-        from juicefs_tpu.tpu.sharding import make_mesh, sharded_scan_step
+        from juicefs_tpu.tpu.sharding import make_mesh, sharded_scan_many, sharded_scan_step
 
         n_dev = len(jax.devices())
         mesh = make_mesh(n_data=n_dev, n_lane=1)
@@ -164,8 +173,34 @@ def main() -> int:
             b = args.batch
             batch_bytes = b * BLOCK_BYTES
         args._mesh = mesh  # _device_bench shards inputs over it
+        args._scan_many = sharded_scan_many(mesh)
+        hash_fn = None
     else:
+        from juicefs_tpu.tpu.hash_jax import hash_packed_jax as hash_fn
+
         step = scan_step_jax
+
+    if hash_fn is not None:
+        # The timed scan runs as ONE device program looping over `iters`
+        # tweaked copies of the batch with a dependent accumulator. For
+        # the XLA backend the xor fuses into the hash's first read (no
+        # extra HBM pass); for pallas the tweak materializes a copy each
+        # iteration (pallas_call is opaque to fusion), so its number is
+        # conservative by one extra HBM write+read per pass. One dispatch
+        # per measurement: per-RPC relay latency (~100ms here) amortizes
+        # away, and a relay that elides repeated identical executions
+        # cannot inflate the number (repeating one no-arg-change call
+        # measured an impossible >10 TiB/s on this tunnel).
+        @jax.jit
+        def scan_many(words, counts, lengths, iters):
+            def body(k, acc):
+                d = hash_fn(words ^ k.astype(jnp.uint32), counts, lengths)
+                dup, first = dedup_scan_jax(d)
+                return acc ^ d.sum(dtype=jnp.uint32) ^ dup.sum().astype(jnp.uint32)
+
+            return lax.fori_loop(jnp.uint32(0), iters, body, jnp.uint32(0))
+
+        args._scan_many = scan_many
 
     try:
         return _device_bench(args, jax, step, rng, b, m, batch_bytes)
@@ -227,14 +262,19 @@ def _device_bench(args, jax, step, rng, b, m, batch_bytes) -> int:
         words, counts, lengths = shard_batch(mesh, words, counts, lengths)
     else:
         counts, lengths = jax.device_put(counts), jax.device_put(lengths)
-    out = step(words, counts, lengths)
-    jax.block_until_ready(out)
 
     total = max(4, int(args.gib * (1 << 30)) // batch_bytes)
+    scan_many = args._scan_many
+    # Warm/compile with iters=1: `iters` is a traced argument, so this
+    # compiles the same program while keeping the TIMED dispatch distinct
+    # from any prior one — a relay that elides repeated identical
+    # executions (observed on this tunnel) can neither skip it nor serve
+    # a cached result.
+    jax.device_get(scan_many(words, counts, lengths, jax.numpy.uint32(1)))
     t0 = time.perf_counter()
-    for _ in range(total):
-        out = step(words, counts, lengths)
-    jax.block_until_ready(out)
+    acc = jax.device_get(
+        scan_many(words, counts, lengths, jax.numpy.uint32(total))
+    )
     dt = time.perf_counter() - t0
     gibs = total * batch_bytes / (1 << 30) / dt
 
@@ -249,6 +289,8 @@ def _device_bench(args, jax, step, rng, b, m, batch_bytes) -> int:
         "block_mib": BLOCK_BYTES >> 20,
         "batch_blocks": b,
         "ms_per_batch": round(dt / total * 1e3, 2),
+        "single_dispatch": True,  # elision-proof: one fused device program
+        "checksum": int(acc),
     }))
     return 0
 

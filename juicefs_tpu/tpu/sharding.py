@@ -43,6 +43,21 @@ def make_mesh(
     return Mesh(arr, ("data", "lane"))
 
 
+def _scan_body(words, lane_counts, lengths):
+    """The shared per-device scan body (used by the one-shot step and the
+    fused benchmark loop — one definition, no drift): row chains on local
+    lanes, gather tiny per-lane digests across the lane axis, combine,
+    gather 32 B/block digests across data, dedup."""
+    local_m = words.shape[1]
+    loff = lax.axis_index("lane") * local_m
+    s = _row_chain_scan(words, _lane_states(words, loff))
+    acc = lax.all_gather(_lane_accs(s, loff), "lane", axis=1, tiled=True)
+    digests = _combine_accs(acc, lane_counts, lengths)
+    all_digests = lax.all_gather(digests, "data", axis=0, tiled=True)
+    dup, first = dedup_scan_jax(all_digests)
+    return all_digests, dup, first
+
+
 def sharded_scan_step(mesh: Mesh):
     """Compile the full multi-chip scan step over `mesh`.
 
@@ -50,27 +65,44 @@ def sharded_scan_step(mesh: Mesh):
     -> (digests (B,8), dup_mask (B,), first_idx (B,)); B must divide by the
     data axis and M by the lane axis. Outputs are fully replicated.
     """
-    n_lane = mesh.shape["lane"]
 
     def step(words, lane_counts, lengths):
-        local_m = words.shape[1]
-        loff = lax.axis_index("lane") * local_m
-        s = _row_chain_scan(words, _lane_states(words, loff))
-        acc = _lane_accs(s, loff)
-        # Gather tiny per-lane digests across the lane axis; each device
-        # then replays the short sequential combine on full lane order.
-        acc = lax.all_gather(acc, "lane", axis=1, tiled=True)
-        digests = _combine_accs(acc, lane_counts, lengths)
-        # Dedup needs the global digest set: gather across data (32 B/block).
-        all_digests = lax.all_gather(digests, "data", axis=0, tiled=True)
-        dup, first = dedup_scan_jax(all_digests)
-        return all_digests, dup, first
+        return _scan_body(words, lane_counts, lengths)
 
     mapped = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(P("data", "lane", None, None), P("data"), P("data")),
         out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def sharded_scan_many(mesh: Mesh):
+    """Multi-iteration sharded scan as ONE device program (the honest
+    benchmark form: per-dispatch relay latency amortizes away and repeated
+    identical dispatches cannot be elided). Each iteration hashes a
+    tweaked copy of the resident batch — the xor fuses into the first
+    read — and the collectives (digest-sized only) repeat per iteration.
+
+    Returns jit(fn(words, lane_counts, lengths, iters) -> uint32 checksum).
+    """
+
+    def many(words, lane_counts, lengths, iters):
+        def body(k, acc):
+            all_d, dup, _first = _scan_body(
+                words ^ k.astype(jnp.uint32), lane_counts, lengths
+            )
+            return acc ^ all_d.sum(dtype=jnp.uint32) ^ dup.sum().astype(jnp.uint32)
+
+        return lax.fori_loop(jnp.uint32(0), iters, body, jnp.uint32(0))
+
+    mapped = jax.shard_map(
+        many,
+        mesh=mesh,
+        in_specs=(P("data", "lane", None, None), P("data"), P("data"), P()),
+        out_specs=P(),
         check_vma=False,
     )
     return jax.jit(mapped)
