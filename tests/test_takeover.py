@@ -102,3 +102,52 @@ def test_open_fd_survives_takeover(tmp_path):
                         p.wait(timeout=5)
                     except subprocess.TimeoutExpired:
                         p.kill()
+
+
+def test_mount_wires_content_indexer_end_to_end(tmp_path):
+    """A volume formatted with a hash backend gets write-path
+    fingerprinting through the REAL mount command: files written via the
+    kernel land digest rows in the meta content index (VERDICT r2 #3,
+    the mount wiring half)."""
+    meta_url = f"sqlite3://{tmp_path}/meta.db"
+    mp = tmp_path / "mnt"
+    mp.mkdir()
+    rc = subprocess.run(
+        [sys.executable, "-m", "juicefs_tpu.cmd", "format", meta_url, "hvol",
+         "--storage", "file", "--bucket", str(tmp_path / "blobs"),
+         "--hash-backend", "cpu", "--trash-days", "0"],
+        cwd="/root/repo",
+    ).returncode
+    assert rc == 0
+
+    p = _mount_proc(meta_url, mp)
+    try:
+        assert _wait_mounted(mp)
+        payload = os.urandom(300_000)
+        with open(mp / "indexed.bin", "wb") as f:
+            f.write(payload)
+        with open(mp / "indexed.bin", "rb") as f:
+            assert f.read() == payload
+    finally:
+        subprocess.run(["fusermount", "-u", str(mp)], capture_output=True)
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+    # the unmounted volume's meta now holds digests for every block,
+    # byte-identical to the spec hash of the stored raw blocks
+    from juicefs_tpu.chunk.cached_store import block_key
+    from juicefs_tpu.cmd import build_store, open_meta
+    from juicefs_tpu.tpu.jth256 import jth256
+
+    m, fmt = open_meta(meta_url)
+    rows = list(m.scan_block_digests())
+    assert rows, "mount did not index written blocks"
+    store = build_store(fmt, None)
+    total = 0
+    for sid, indx, bsize, digest in rows:
+        raw = store._load_block(block_key(sid, indx, bsize), bsize)
+        assert digest == jth256(raw)
+        total += bsize
+    assert total >= 300_000
