@@ -24,7 +24,7 @@ from typing import Callable, Optional
 from ..compress import new_compressor
 from ..object.interface import NotFoundError, ObjectStorage
 from ..utils import get_logger
-from .disk_cache import CacheManager
+from .disk_cache import CacheManager, DiskCache
 from .mem_cache import MemCache
 from .prefetch import Prefetcher
 from .singleflight import SingleFlight
@@ -247,6 +247,15 @@ class CachedStore:
                     raw = f.read()
             except OSError:
                 continue
+            parsed = parse_block_key(key)
+            if parsed is not None and len(raw) > parsed[2] > 0:
+                # older versions trailered staging files in place during
+                # uploaded(); a crash in that window left payload plus a
+                # complete or partial trailer
+                raw = DiskCache.strip_stale_trailer(raw, parsed[2])
+                # rewrite the staged copy too, so uploaded() (which re-reads
+                # the file) never enshrines the stale bytes in the cache
+                self.cache.stage(key, raw)
             logger.warning("found staged block %s, uploading", key)
             with self._pending_lock:
                 self._pending_staged[key] = raw
@@ -406,6 +415,14 @@ class RSlice:
                     loads[indx] = self.store._rpool.submit(
                         self.store._load_block, key, bsize
                     )
+            if loads:
+                # sequential readahead: warm the block after the last
+                # segment, mirroring the single-segment miss branch (large
+                # streaming reads are exactly the case that wants it)
+                nindx = segs[-1][0] + 1
+                if nindx * self.bs < self.length:
+                    nsize = self._block_size(nindx)
+                    self.store._fetcher.fetch((block_key(self.id, nindx, nsize), nsize))
 
         out = bytearray()
         for indx, bsize, boff, n in segs:

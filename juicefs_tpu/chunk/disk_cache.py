@@ -116,11 +116,15 @@ class DiskCache:
 
     def cache(self, key: str, data: bytes) -> None:
         path = self._raw_path(key)
+        # _used/_index always account the ON-DISK size (payload + trailer),
+        # matching _scan_existing, so eviction targets are computed against
+        # real disk usage
+        ondisk = len(data) + (_TRAILER.size if self.checksum else 0)
         with self._lock:
             if key in self._index:
                 return
-            self._index[key] = (len(data), time.time())
-            self._used += len(data)
+            self._index[key] = (ondisk, time.time())
+            self._used += ondisk
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             tmp = path + ".tmp"
@@ -136,7 +140,7 @@ class DiskCache:
             logger.warning("cache write failed %s: %s", key, e)
             with self._lock:
                 if self._index.pop(key, None) is not None:
-                    self._used -= len(data)
+                    self._used -= ondisk
             return
         self._maybe_evict()
 
@@ -165,8 +169,11 @@ class DiskCache:
                 self._drop_corrupt(key, "crc mismatch (bitrot?)")
                 return None
         with self._lock:
-            if key in self._index:
-                self._index[key] = (len(data), time.time())
+            item = self._index.get(key)
+            if item is not None:
+                # refresh atime only; the recorded size stays the on-disk
+                # size so accounting doesn't drift from real usage
+                self._index[key] = (item[0], time.time())
         return data
 
     def _drop_corrupt(self, key: str, why: str) -> None:
@@ -229,29 +236,52 @@ class DiskCache:
 
     def uploaded(self, key: str, size: int) -> None:
         """Move a staged block into the normal cache after upload
-        (reference disk_cache.go uploaded). Staging files are raw (crash
-        recovery reads them verbatim), so the checksum trailer is added
-        on the way into raw/."""
+        (reference disk_cache.go uploaded). The staged copy is NEVER
+        mutated: the checksum trailer is written while copying into raw/
+        (tmp + rename), so a crash at any point leaves either a pristine
+        raw staging file (re-uploaded verbatim on restart) or a complete
+        trailered cache entry — never a trailered staging file that
+        recovery would re-upload with 8 extra bytes."""
         spath = self._stage_path(key)
+        rpath = self._raw_path(key)
         try:
-            if self.checksum:
-                # append the trailer in place, then atomically rename: the
-                # staged copy survives any failure (a partial trailer just
-                # fails verification and refetches), and no block rewrite
-                with open(spath, "r+b") as f:
-                    data = f.read()
-                    f.write(_TRAILER.pack(_MAGIC, zlib.crc32(data)))
-            rpath = self._raw_path(key)
             os.makedirs(os.path.dirname(rpath), exist_ok=True)
-            os.replace(spath, rpath)
+            if not self.checksum:
+                # no trailer to add: the atomic rename is already crash-safe
+                # and costs no block copy
+                os.replace(spath, rpath)
+            else:
+                with open(spath, "rb") as f:
+                    data = f.read()
+                tmp = rpath + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.write(_TRAILER.pack(_MAGIC, zlib.crc32(data)))
+                os.replace(tmp, rpath)
             st = os.stat(rpath)
             with self._lock:
                 if key not in self._index:
                     self._index[key] = (st.st_size, time.time())
                     self._used += st.st_size
+            if self.checksum:
+                # crash between replace and unlink is safe: restart
+                # re-uploads (idempotent PUT) and lands here again
+                os.unlink(spath)
         except OSError:
             pass
         self._maybe_evict()
+
+    @staticmethod
+    def strip_stale_trailer(raw: bytes, expect_size: int) -> bytes:
+        """Recover the payload of a staging file longer than its block size.
+        Older versions trailered staging files in place before renaming; a
+        crash in that window left payload + (possibly partial) trailer.
+        Staged payloads are fully written + fsynced before their own rename,
+        so anything past expect_size is junk from that legacy append —
+        truncate to the block size parsed from the key."""
+        if 0 < expect_size < len(raw):
+            return raw[:expect_size]
+        return raw
 
     def scan_staging(self) -> dict[str, str]:
         """key -> path of blocks written back before a crash

@@ -53,6 +53,11 @@ def run(args) -> int:
 
     for k, v in changes.items():
         setattr(fmt, k, v)
+    if "hash_backend" in changes and changes["hash_backend"]:
+        # hash_backend is v2-gated: Format.from_json drops an explicit
+        # value on v1 records, so the opt-in must bump the version or it
+        # silently never takes effect
+        fmt.meta_version = max(fmt.meta_version, 2)
     st = m.init(fmt, force=True)  # same-uuid overwrite of the record
     if st:
         print(f"config update: errno {st}")

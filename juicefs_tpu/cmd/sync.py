@@ -166,7 +166,7 @@ def _copy_object(src, dst, obj, args, stats) -> None:
     if up is None:
         data = bytes(src.get(obj.key))
         dst.put(obj.key, data)
-        stats["copied_bytes"] += len(data)
+        stats.add("copied_bytes", len(data))
         return
     part_size = max(part_size, up.min_part_size)
     n_parts = (obj.size + part_size - 1) // part_size
@@ -180,7 +180,7 @@ def _copy_object(src, dst, obj, args, stats) -> None:
             n = min(part_size, obj.size - off)
             data = bytes(src.get(obj.key, off, n))
             parts.append(dst.upload_part(obj.key, up.upload_id, i + 1, data))
-            stats["copied_bytes"] += n
+            stats.add("copied_bytes", n)
         dst.complete_upload(obj.key, up.upload_id, parts)
     except BaseException:
         try:
@@ -199,48 +199,62 @@ def _make_executor(src, dst, args, stats):
         try:
             if op == "copy":
                 if args.dry:
-                    stats["copied"] += 1
+                    stats.add("copied")
                 else:
                     if bucket is not None:
                         bucket.take(s.size)
                     _copy_object(src, dst, s, args, stats)
-                    stats["copied"] += 1
+                    stats.add("copied")
                     if args.check_new and not _content_equal(
                             src, dst, s.key, s.size):
-                        stats["mismatch"] += 1
+                        stats.add("mismatch")
                         logger.error("verify failed after copy: %s", s.key)
                     if args.delete_src:
                         src.delete(s.key)
-                        stats["deleted"] += 1
+                        stats.add("deleted")
             elif op == "del-dst":
                 if not args.dry:
                     dst.delete(d.key)
-                stats["deleted"] += 1
+                stats.add("deleted")
             elif op == "del-src":
                 if not args.dry:
                     src.delete(s.key)
-                stats["deleted"] += 1
+                stats.add("deleted")
             elif op == "check":
-                stats["checked"] += 1
+                stats.add("checked")
                 if not _content_equal(src, dst, s.key, s.size):
-                    stats["mismatch"] += 1
+                    stats.add("mismatch")
                     logger.error("content mismatch: %s", s.key)
             # counted only on full execution: a BaseException (interrupt)
             # skips this, so the manager sees the task as unaccounted
-            stats["tasks_done"] += 1
+            stats.add("tasks_done")
         except Exception as e:
             logger.error("%s %s: %s", op, (s or d).key, e)
-            stats["skipped"] += 1
-            stats["tasks_done"] += 1
+            stats.add("skipped")
+            stats.add("tasks_done")
 
     return do
 
 
-def _new_stats() -> dict:
+class _Stats(dict):
+    """Counter dict updated concurrently by pool workers; the bare
+    `d[k] += 1` read-modify-write loses updates under threads, and a lost
+    tasks_done makes the cluster manager report a spurious partial sync."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.lock = threading.Lock()
+
+    def add(self, key: str, n: int = 1) -> None:
+        with self.lock:
+            self[key] = self.get(key, 0) + n
+
+
+def _new_stats() -> _Stats:
     # tasks_done counts tasks that ran to completion (including skips):
     # the manager's completion check compares it against dispatched count
-    return {"copied": 0, "copied_bytes": 0, "deleted": 0, "checked": 0,
-            "mismatch": 0, "skipped": 0, "tasks_done": 0}
+    return _Stats({"copied": 0, "copied_bytes": 0, "deleted": 0, "checked": 0,
+                   "mismatch": 0, "skipped": 0, "tasks_done": 0})
 
 
 def run(args) -> int:

@@ -148,6 +148,58 @@ def test_staging_recovery(tmp_path):
     assert storage.get(key) == data
 
 
+def test_staging_recovery_strips_stale_trailer(tmp_path):
+    """A crash inside the old uploaded() window left a staging file with a
+    checksum trailer appended in place; recovery must re-upload the bare
+    payload, not payload+trailer (ADVICE r3 medium)."""
+    import struct
+    import zlib
+
+    cache_dir = tmp_path / "cache"
+    storage = MemStorage()
+    dc = DiskCache(str(cache_dir))
+    data = os.urandom(65536)
+    key = block_key(37, 0, 65536)
+    path = dc.stage(key, data)
+    # simulate the legacy in-place trailer append, then "crash" pre-rename
+    with open(path, "ab") as f:
+        f.write(struct.pack("<4sI", b"JFC1", zlib.crc32(data)))
+    # and a second block whose trailer append itself crashed partway
+    data2 = os.urandom(65536)
+    key2 = block_key(38, 0, 65536)
+    path2 = dc.stage(key2, data2)
+    with open(path2, "ab") as f:
+        f.write(b"JFC")
+    dc.close()
+    store = CachedStore(
+        storage,
+        ChunkConfig(block_size=1 << 16, cache_dirs=(str(cache_dir),), writeback=True),
+    )
+    store.flush_all()
+    assert storage.get(key) == data  # exactly bsize bytes, trailer stripped
+    assert storage.get(key2) == data2  # partial trailer junk truncated
+    r = store.new_reader(37, len(data))
+    assert r.read(0, len(data)) == data
+    # the raw cache entry must hold exactly the payload, not stale bytes
+    assert store.cache.load(key) == data
+    assert store.cache.load(key2) == data2
+    store.close()
+
+
+def test_uploaded_never_mutates_staging(tmp_path):
+    """uploaded() copies staging→raw (tmp+rename); the staged file is
+    removed only after the raw entry is complete, and is never trailered."""
+    cache_dir = tmp_path / "cache"
+    dc = DiskCache(str(cache_dir))
+    data = os.urandom(4096)
+    key = "chunks/0/0/41_0_4096"
+    dc.stage(key, data)
+    dc.uploaded(key, len(data))
+    assert not os.path.exists(dc._stage_path(key))
+    assert dc.load(key) == data  # trailered raw entry verifies
+    dc.close()
+
+
 def test_fill_and_check_cache():
     store = make_store()
     data = os.urandom(65536 * 2)

@@ -61,7 +61,8 @@ def test_multipart_copy_uses_ranged_parts(tmp_path, capsys):
 
     src.get = spy_get
     args = SimpleNamespace(big_threshold=1, part_size=1)  # 1 MiB / 1 MiB
-    stats = {"copied_bytes": 0}
+    from juicefs_tpu.cmd.sync import _new_stats
+    stats = _new_stats()
     obj = next(o for o in src.list_all("") if o.key == "big.bin")
     _copy_object(src, dst, obj, args, stats)
     assert (dst_root / "big.bin").read_bytes() == big
