@@ -253,6 +253,11 @@ class DiskCache:
             else:
                 with open(spath, "rb") as f:
                     data = f.read()
+                if 0 < size < len(data):
+                    # legacy trailered staging file whose re-stage failed
+                    # during recovery: the caller knows the true payload
+                    # size — never enshrine the stale tail in the cache
+                    data = data[:size]
                 tmp = rpath + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(data)

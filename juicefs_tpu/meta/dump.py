@@ -14,9 +14,17 @@ DUMP_VERSION = 1
 
 
 def dump_doc(meta) -> dict:
+    """Snapshot any engine into the portable KV-record document. KV engines
+    scan their store directly; the relational engine converts its tables
+    into the same record schema (sql.py export_kv_records) — so dumps move
+    between engine families (reference: engine migration via dump/load)."""
+    if hasattr(meta, "export_kv_records"):
+        pairs = meta.export_kv_records()
+    else:
+        pairs = meta.client.scan(b"", b"\xff" * 9)
     records = [
         [base64.b64encode(k).decode(), base64.b64encode(v).decode()]
-        for k, v in meta.client.scan(b"", b"\xff" * 9)
+        for k, v in pairs
     ]
     return {"version": DUMP_VERSION, "engine": meta.name(), "records": records}
 
@@ -24,14 +32,20 @@ def dump_doc(meta) -> dict:
 def load_doc(meta, doc: dict, force: bool = False) -> int:
     if doc.get("version") != DUMP_VERSION:
         raise ValueError(f"unsupported dump version {doc.get('version')}")
+    records = [
+        (base64.b64decode(k), base64.b64decode(v)) for k, v in doc["records"]
+    ]
+    if hasattr(meta, "import_kv_records"):
+        if meta.has_records():
+            if not force:
+                raise RuntimeError("target meta engine not empty (use force)")
+            meta.do_reset()
+        return meta.import_kv_records(records)
     existing = next(iter(meta.client.scan(b"", b"\xff" * 9)), None)
     if existing is not None:
         if not force:
             raise RuntimeError("target meta engine not empty (use force)")
         meta.client.reset()
-    records = [
-        (base64.b64decode(k), base64.b64decode(v)) for k, v in doc["records"]
-    ]
 
     def fn(tx):
         for k, v in records:

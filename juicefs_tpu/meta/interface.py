@@ -37,6 +37,7 @@ def new_client(uri: str, **kw) -> "Meta":
     if scheme not in _registry:
         # default drivers are registered lazily to avoid import cycles
         from . import kv  # noqa: F401
+        from . import sql  # noqa: F401
     if scheme not in _registry:
         raise ValueError(f"invalid meta driver: {scheme}")
     return _registry[scheme](scheme, addr)

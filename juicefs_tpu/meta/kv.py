@@ -32,7 +32,6 @@ the cleanest of its three engines — SURVEY.md §7.1):
 
 from __future__ import annotations
 
-import calendar
 import errno
 import json
 import struct
@@ -1865,35 +1864,6 @@ class KVMeta(BaseMeta):
         total = self.fmt.capacity or (1 << 50)
         iavail = (self.fmt.inodes - iused) if self.fmt.inodes else (10 << 20)
         return total, max(total - used, 0), iused, max(iavail, 0)
-
-    def cleanup_trash_before(self, ts: float) -> int:
-        """Purge trash subdirectories older than `ts`
-        (reference base.go:2281 CleanupTrashBefore)."""
-        removed = 0
-        st, entries = self.do_readdir(Context(check_permission=False), TRASH_INODE, False)
-        if st:
-            return 0
-        for e in entries:
-            if e.name in (b".", b".."):
-                continue
-            try:
-                t = calendar.timegm(time.strptime(e.name.decode(), "%Y-%m-%d-%H"))
-            except ValueError:
-                continue
-            if t < ts:
-                st2, n = self.remove_recursive(
-                    Context(check_permission=False), TRASH_INODE, e.name, skip_trash=True
-                )
-                removed += n
-        return removed
-
-    def scan_deleted_objects(self) -> tuple[dict[int, int], int]:
-        """(pending delfiles, trash entry count) for gc reporting
-        (reference base.go:2402 ScanDeletedObject)."""
-        delfiles = self.do_find_deleted_files(1 << 30)
-        st, s = self.summary(Context(check_permission=False), TRASH_INODE)
-        return delfiles, (s.files if st == 0 else 0)
-
 
 def _factory(scheme: str, addr: str) -> KVMeta:
     client = new_tkv_client(scheme, addr)
