@@ -26,3 +26,61 @@ if not os.environ.get("JFS_TEST_REAL_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def fuse_mount(tmp_path, block_size=1 << 20, cache_dirs=("memory",),
+               **format_kw):
+    """Shared FUSE loop-mount lifecycle (used by test_fuse / test_fsx /
+    test_posix_oracle): build the full stack on mem:// meta + mem://
+    objects, mount, wait for the kernel INIT handshake, yield the
+    mountpoint, and tear down. One copy so readiness/teardown fixes land
+    everywhere at once."""
+    import os
+    import shutil
+    import time
+
+    import pytest
+
+    if not os.path.exists("/dev/fuse") or shutil.which("fusermount") is None:
+        pytest.skip("FUSE not available")
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.fuse import Server
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.vfs import VFS
+
+    format_kw.setdefault("name", "fusetest")
+    format_kw.setdefault("storage", "mem")
+    m = new_client("mem://")
+    m.init(Format(block_size=block_size >> 10, **format_kw), force=False)
+    m.load()
+    m.new_session()
+    store = CachedStore(
+        create_storage("mem://"),
+        ChunkConfig(block_size=block_size, cache_dirs=tuple(cache_dirs)),
+    )
+    v = VFS(m, store)
+    mp = tmp_path / "mnt"
+    mp.mkdir(exist_ok=True)
+    srv = Server(v, str(mp))
+    try:
+        srv.serve_background()
+    except OSError as e:
+        pytest.skip(f"cannot mount: {e}")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            os.statvfs(mp)
+            break
+        except OSError:
+            time.sleep(0.05)
+    try:
+        yield str(mp)
+    finally:
+        srv.unmount()
+        time.sleep(0.1)
+        v.close()

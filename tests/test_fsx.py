@@ -96,68 +96,41 @@ def test_fsx_random_data_ops(tmp_path, seed):
 def test_fsx_through_kernel(tmp_path):
     """Short fsx run over a real kernel mount: page cache + writeback +
     FUSE channel all in the loop."""
-    import shutil
-    import time
+    from conftest import fuse_mount
 
-    if shutil.which("fusermount") is None:
-        pytest.skip("fusermount missing")
-    from juicefs_tpu.fuse import Server
-
-    m = new_client("mem://")
-    m.init(Format(name="fsxk", trash_days=0), force=False)
-    m.new_session()
-    store = CachedStore(
-        create_storage("mem://"),
-        ChunkConfig(block_size=1 << 18, cache_dirs=(str(tmp_path / "c"),)),
-    )
-    v = VFS(m, store)
-    mp = tmp_path / "mnt"
-    mp.mkdir()
-    srv = Server(v, str(mp))
-    try:
-        srv.serve_background()
-    except OSError as e:
-        pytest.skip(f"cannot mount: {e}")
-    deadline = time.time() + 5
-    while time.time() < deadline:
+    with fuse_mount(tmp_path, block_size=1 << 18, name="fsxk", trash_days=0,
+                    cache_dirs=(str(tmp_path / "c"),)) as mp:
+        rng = random.Random(11)
+        path = os.path.join(mp, "fsx.dat")
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        model = bytearray()
         try:
-            os.statvfs(mp)
-            break
-        except OSError:
-            time.sleep(0.05)
-    rng = random.Random(11)
-    path = str(mp / "fsx.dat")
-    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
-    model = bytearray()
-    try:
-        for opno in range(150):
-            op = rng.choice(["write", "write", "read", "truncate", "fsync"])
-            if op == "write":
-                off = rng.randrange(0, 1 << 20)
-                n = rng.randrange(1, 100_000)
-                data = os.urandom(n)
-                os.pwrite(fd, data, off)
-                if off > len(model):
-                    model.extend(b"\x00" * (off - len(model)))
-                model[off:off + n] = data
-            elif op == "read":
-                off = rng.randrange(0, 1 << 20)
-                n = rng.randrange(1, 150_000)
-                got = os.pread(fd, n, off)
-                assert got == bytes(model[off:off + n]), f"op {opno}"
-            elif op == "truncate":
-                length = rng.randrange(0, 1 << 20)
-                os.ftruncate(fd, length)
-                if length <= len(model):
-                    del model[length:]
+            for opno in range(150):
+                op = rng.choice(["write", "write", "read", "truncate", "fsync"])
+                if op == "write":
+                    off = rng.randrange(0, 1 << 20)
+                    n = rng.randrange(1, 100_000)
+                    data = os.urandom(n)
+                    os.pwrite(fd, data, off)
+                    if off > len(model):
+                        model.extend(b"\x00" * (off - len(model)))
+                    model[off:off + n] = data
+                elif op == "read":
+                    off = rng.randrange(0, 1 << 20)
+                    n = rng.randrange(1, 150_000)
+                    got = os.pread(fd, n, off)
+                    assert got == bytes(model[off:off + n]), f"op {opno}"
+                elif op == "truncate":
+                    length = rng.randrange(0, 1 << 20)
+                    os.ftruncate(fd, length)
+                    if length <= len(model):
+                        del model[length:]
+                    else:
+                        model.extend(b"\x00" * (length - len(model)))
                 else:
-                    model.extend(b"\x00" * (length - len(model)))
-            else:
-                os.fsync(fd)
-            assert os.fstat(fd).st_size == len(model), f"op {opno}: size"
-        os.fsync(fd)
-        assert os.pread(fd, len(model) + 10, 0) == bytes(model)
-    finally:
-        os.close(fd)
-        srv.unmount()
-        v.close()
+                    os.fsync(fd)
+                assert os.fstat(fd).st_size == len(model), f"op {opno}: size"
+            os.fsync(fd)
+            assert os.pread(fd, len(model) + 10, 0) == bytes(model)
+        finally:
+            os.close(fd)

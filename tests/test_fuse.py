@@ -21,39 +21,10 @@ pytestmark = pytest.mark.skipif(
 
 @pytest.fixture
 def mnt(tmp_path):
-    from juicefs_tpu.chunk import CachedStore, ChunkConfig
-    from juicefs_tpu.fuse import Server
-    from juicefs_tpu.meta import Format, new_client
-    from juicefs_tpu.object import create_storage
-    from juicefs_tpu.vfs import VFS
+    from conftest import fuse_mount
 
-    m = new_client("mem://")
-    m.init(Format(name="fusetest", storage="mem", block_size=1 << 20), force=False)
-    m.new_session()
-    store = CachedStore(
-        create_storage("mem://"),
-        ChunkConfig(block_size=1 << 20, cache_dirs=(str(tmp_path / "cache"),)),
-    )
-    v = VFS(m, store)
-    mp = tmp_path / "mnt"
-    mp.mkdir()
-    srv = Server(v, str(mp))
-    try:
-        srv.serve_background()
-    except OSError as e:
-        pytest.skip(f"cannot mount: {e}")
-    # wait for INIT to complete
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        try:
-            os.statvfs(mp)
-            break
-        except OSError:
-            time.sleep(0.05)
-    yield str(mp)
-    srv.unmount()
-    time.sleep(0.1)
-    v.close()
+    with fuse_mount(tmp_path, cache_dirs=(str(tmp_path / "cache"),)) as mp:
+        yield mp
 
 
 def test_basic_file_io(mnt):
