@@ -140,6 +140,25 @@ class _RedisTxn(KVTxn):
         self._read_cache[key] = val
         return val
 
+    def gets(self, *keys):
+        """One WATCH + one MGET round trip for a batch of point reads
+        (readdirplus attr assembly: per-entry GETs dominate first-listing
+        latency on a networked engine)."""
+        missing = [
+            k for k in keys
+            if k not in self._writes and k not in self._read_cache
+        ]
+        if missing:
+            self._conn.send([b"WATCH"] + missing, [b"MGET"] + missing)
+            self._conn.read_reply()
+            vals = self._conn.read_reply()
+            for k, v in zip(missing, vals):
+                self._read_cache[k] = v
+        return [
+            self._writes[k] if k in self._writes else self._read_cache[k]
+            for k in keys
+        ]
+
     def set(self, key: bytes, value: bytes) -> None:
         self._writes[key] = bytes(value)
 

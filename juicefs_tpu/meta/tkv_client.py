@@ -242,6 +242,22 @@ class _SqliteTxn(KVTxn):
         row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
         return bytes(row[0]) if row else None
 
+    def gets(self, *keys):
+        """Batched point lookups in ONE statement (the readdirplus attr
+        assembly path: per-entry SELECTs dominate first-listing latency)."""
+        if not keys:
+            return []
+        found = {}
+        ks = list(keys)
+        for i in range(0, len(ks), 512):  # sqlite parameter limit headroom
+            chunk = ks[i:i + 512]
+            q = "SELECT k, v FROM kv WHERE k IN ({})".format(
+                ",".join("?" * len(chunk))
+            )
+            for k, v in self._conn.execute(q, chunk):
+                found[bytes(k)] = bytes(v)
+        return [found.get(bytes(k)) for k in keys]
+
     def set(self, key, value):
         self._conn.execute(
             "INSERT INTO kv(k, v) VALUES(?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
@@ -327,6 +343,40 @@ class SqliteKV(TKVClient):
                 finally:
                     self._local.in_txn = False
         raise last  # type: ignore[misc]
+
+    def simple_txn(self, fn):
+        """Read-mostly transaction: BEGIN DEFERRED snapshot, no writer
+        lock — in WAL mode readers never block (or take) the single write
+        lock, so hot read paths (lookup/getattr/readdir) don't serialize
+        behind writers the way BEGIN IMMEDIATE does."""
+        conn = self._get_conn()
+        if getattr(self._local, "in_txn", False):
+            return fn(_SqliteTxn(conn))
+        for attempt in range(50):
+            try:
+                conn.execute("BEGIN")
+                self._local.in_txn = True
+                before = conn.total_changes
+                tx = _SqliteTxn(conn)
+                ok = False
+                try:
+                    result = fn(tx)
+                    ok = True
+                    return result
+                finally:
+                    self._local.in_txn = False
+                    # same contract as txn(): an exception or discard()
+                    # must never commit partial writes; a caller that
+                    # (unexpectedly) wrote and returned cleanly commits
+                    wrote = conn.total_changes != before
+                    conn.execute(
+                        "COMMIT" if (ok and wrote and not tx._discarded)
+                        else "ROLLBACK"
+                    )
+            except sqlite3.OperationalError:
+                self._local.in_txn = False
+                time.sleep(min(0.001 * (1 << min(attempt, 8)), 0.1))
+        return self.txn(fn)  # fall back to the write path
 
     def scan(self, begin, end):
         conn = self._get_conn()

