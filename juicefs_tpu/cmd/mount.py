@@ -28,6 +28,15 @@ def add_parser(sub):
     p.add_argument("--cache-size", default=0, type=int, help="cache size MiB")
     p.add_argument("--writeback", action="store_true")
     p.add_argument("--max-readahead", type=int, default=8, help="MiB")
+    p.add_argument("--attr-cache", type=float, default=1.0,
+                   help="attr cache TTL seconds (reference --attr-cache)")
+    p.add_argument("--entry-cache", type=float, default=1.0,
+                   help="dentry cache TTL seconds (reference --entry-cache)")
+    p.add_argument("--dir-entry-cache", type=float, default=1.0,
+                   help="readdir snapshot TTL seconds")
+    p.add_argument("--heartbeat", type=float, default=12.0,
+                   help="session heartbeat interval seconds (also the push-"
+                        "invalidation exchange cadence)")
     p.add_argument("--metrics", default="",
                    help="host:port for the /metrics endpoint (reference "
                         "exposeMetrics; empty disables, port 0 auto-picks)")
@@ -68,6 +77,16 @@ def serve(args) -> int:
     m, fmt = open_meta(args.meta_url)
     storage_for(fmt)  # raises on a broken storage configuration
 
+    if args.heartbeat <= 0:
+        logger.warning("--heartbeat %.1f invalid; using 1s", args.heartbeat)
+        args.heartbeat = 1.0
+    elif args.heartbeat >= 300:
+        # stale-session GC reaps sessions whose beat is older than 300s
+        logger.warning("--heartbeat %.1f >= the 300s staleness age; "
+                       "capping at 60s so the session is never reaped live",
+                       args.heartbeat)
+        args.heartbeat = 60.0
+
     # seamless upgrade (reference cmd/passfd.go): ask the predecessor for
     # its live fuse fd + open-handle state
     takeover = None
@@ -82,13 +101,15 @@ def serve(args) -> int:
         # inherit the predecessor's session: locks and sustained inodes
         # keyed by sid remain valid across the swap
         m.sid = int(takeover[1]["sid"])
-        m.start_heartbeat(12.0)
+        m.start_heartbeat(args.heartbeat)
     else:
-        m.new_session(heartbeat=12.0)
+        m.new_session(heartbeat=args.heartbeat)
     vfs = VFS(
         m,
         store,
-        VFSConfig(readonly=args.readonly, max_readahead=args.max_readahead << 20),
+        VFSConfig(readonly=args.readonly, max_readahead=args.max_readahead << 20,
+                  attr_timeout=args.attr_cache, entry_timeout=args.entry_cache,
+                  dir_entry_timeout=args.dir_entry_cache),
         fmt,
     )
     # message handlers (reference registerMetaMsg cmd/mount.go:271):

@@ -68,6 +68,11 @@ SETUPMAPPING = 48
 REMOVEMAPPING = 49
 SYNCFS = 50
 TMPFILE = 51
+
+# server->kernel notifications (written with unique=0, error=+code;
+# linux fuse.h enum fuse_notify_code)
+NOTIFY_INVAL_INODE = 2
+NOTIFY_INVAL_ENTRY = 3
 STATX = 52
 
 OPCODE_NAMES = {
@@ -123,6 +128,8 @@ ACCESS_IN = struct.Struct("<II")  # mask padding
 FORGET_IN = struct.Struct("<Q")  # nlookup
 BATCH_FORGET_IN = struct.Struct("<II")  # count dummy
 INTERRUPT_IN = struct.Struct("<Q")  # unique
+NOTIFY_INVAL_INODE_OUT = struct.Struct("<Qqq")  # ino off len
+NOTIFY_INVAL_ENTRY_OUT = struct.Struct("<QII")  # parent namelen padding
 FALLOCATE_IN = struct.Struct("<QQQII")  # fh offset length mode padding
 COPY_FILE_RANGE_IN = struct.Struct("<QQQQQQQ")  # fh_in off_in nodeid_out fh_out off_out len flags
 LSEEK_IN = struct.Struct("<QQII")  # fh offset whence padding

@@ -68,11 +68,13 @@ class Server:
         writeback_cache: bool = True,
     ):
         self.vfs = vfs
+        vfs.kernel_notifier = self  # push-invalidation -> kernel caches
         self.mountpoint = os.path.abspath(mountpoint)
         self.fsname = fsname
         self.allow_other = allow_other
         self._fd = -1
         self._wlock = threading.Lock()
+        self._nlock = threading.Lock()  # notify writes; never _wlock (see _notify)
         self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="fuse")
         self._stop = threading.Event()
         self._workers = workers
@@ -326,6 +328,44 @@ class Server:
             except OSError as e:
                 if e.errno not in (_errno.ENOENT, _errno.ENODEV, _errno.EBADF):
                     raise
+
+    # -- kernel cache invalidation (reference pkg/vfs/vfs.go:1228) ---------
+    def _notify(self, code: int, payload: bytes) -> None:
+        """Unsolicited server->kernel message: unique=0, error=+code.
+        Best-effort — ENOENT means the kernel had nothing cached.
+
+        Deliberately NOT under _wlock: the kernel may block a reverse
+        invalidation on a lock held by an in-flight request (e.g.
+        fuse_reverse_inval_entry on the parent's i_rwsem during a
+        concurrent unlink, or inval_inode on dirty-page writeback) —
+        serializing notifies with replies would deadlock the mount. Each
+        writev is one atomic syscall, so no interleaving can occur; a
+        separate lock only orders notifies against each other."""
+        if self._fd < 0:
+            return
+        hdr = k.OUT_HEADER.pack(k.OUT_HEADER_SIZE + len(payload), code, 0)
+        with self._nlock:
+            try:
+                os.writev(self._fd, (hdr, payload))
+            except OSError as e:
+                if e.errno not in (_errno.ENOENT, _errno.ENODEV,
+                                   _errno.EBADF, _errno.ENOTCONN):
+                    raise
+
+    def notify_inval_inode(self, ino: int, off: int = 0, length: int = -1) -> None:
+        """Drop the kernel's attr + page cache for an inode (another
+        client changed it)."""
+        self._notify(k.NOTIFY_INVAL_INODE,
+                     k.NOTIFY_INVAL_INODE_OUT.pack(ino, off, length))
+
+    def notify_inval_entry(self, parent: int, name: bytes) -> None:
+        """Drop one dcache entry under `parent` (another client renamed /
+        unlinked / created it)."""
+        self._notify(
+            k.NOTIFY_INVAL_ENTRY,
+            k.NOTIFY_INVAL_ENTRY_OUT.pack(parent, len(name), 0)
+            + bytes(name) + b"\x00",
+        )
 
     def _entry_out(self, ino: int, attr: Attr) -> bytes:
         ttl = self._entry_ttl

@@ -72,6 +72,17 @@ class BaseMeta(interface.Meta):
         self._lock_waits: dict[int, list] = {}
         self._lock_waits_mu = threading.Lock()
         self._reload_cbs: list[Callable] = []  # config hot-reload hooks
+        # push invalidation (VERDICT r3 #4; reference pkg/vfs/vfs.go:1228
+        # kernel invalidation + openfile invalidation protocol): mutations
+        # buffer (kind, ...) events here; the session refresher publishes
+        # them through the engine and fetches peers' events, fanning them
+        # to on_invalidate subscribers (the VFS drops TTL caches and pokes
+        # the kernel dcache). Purely an acceleration of the TTL contract —
+        # a lost event still expires at the TTL.
+        self._inval_buf: list[tuple] = []
+        self._inval_mu = threading.Lock()
+        self._inval_cbs: list[Callable] = []
+        self._inval_seq = -1  # last peer sequence seen (-1 = from "now")
 
     # -- abstract engine ops (reference base.go:51-125) --------------------
     def do_init(self, fmt: Format, force: bool) -> int: ...
@@ -196,8 +207,95 @@ class BaseMeta(interface.Meta):
             try:
                 self.do_refresh_session(self.sid)
                 self._check_reload()
+                self._exchange_invalidations()
             except Exception as e:  # pragma: no cover - background resilience
                 logger.warning("session refresh failed: %s", e)
+
+    # -- push invalidation --------------------------------------------------
+    def on_invalidate(self, cb: Callable[[list[tuple]], None]) -> None:
+        """Subscribe to peers' change events: cb(events) with events a list
+        of ("a", ino) attr / ("e", parent, name) dentry invalidations."""
+        self._inval_cbs.append(cb)
+
+    def off_invalidate(self, cb: Callable) -> None:
+        """Unsubscribe (a closed VFS must not be poked by future beats)."""
+        try:
+            self._inval_cbs.remove(cb)
+        except ValueError:
+            pass
+
+    # shared wire codec for the invalidation journal — one implementation
+    # for every engine, so an event-format change cannot desynchronize them
+    @staticmethod
+    def _encode_inval_events(events: list[tuple]) -> str:
+        import base64
+        import json as _json
+
+        return _json.dumps([
+            [e[0], e[1]] if e[0] == "a"
+            else [e[0], e[1], base64.b64encode(e[2]).decode()]
+            for e in events
+        ])
+
+    @staticmethod
+    def _decode_inval_events(raw) -> list[tuple]:
+        import base64
+        import json as _json
+
+        out: list[tuple] = []
+        try:
+            for e in _json.loads(raw):
+                if e[0] == "a":
+                    out.append(("a", e[1]))
+                else:
+                    out.append(("e", e[1], base64.b64decode(e[2])))
+        except (ValueError, IndexError, TypeError):
+            pass
+        return out
+
+    def _note_change(self, *events: tuple) -> None:
+        """Record local mutations for the next heartbeat's publish. No-op
+        until a session with callbacks-or-peers exists (tools that run
+        without sessions pay nothing)."""
+        if not self.sid:
+            return
+        with self._inval_mu:
+            self._inval_buf.extend(events)
+            if len(self._inval_buf) > 10_000:  # runaway guard: TTL still heals
+                del self._inval_buf[:5_000]
+
+    def _exchange_invalidations(self) -> None:
+        with self._inval_mu:
+            batch, self._inval_buf = self._inval_buf, []
+        if batch:
+            try:
+                self.do_publish_invalidations(self.sid, batch)
+            except Exception as e:
+                logger.warning("publish invalidations: %s", e)
+        try:
+            seq, events = self.do_fetch_invalidations(self._inval_seq, self.sid)
+        except Exception as e:
+            logger.warning("fetch invalidations: %s", e)
+            return
+        self._inval_seq = seq
+        if events:
+            for ev in events:
+                kind = ev[0]
+                if kind == "a":
+                    self.of.invalidate(ev[1])
+            for cb in self._inval_cbs:
+                try:
+                    cb(events)
+                except Exception as e:
+                    logger.warning("invalidate callback failed: %s", e)
+
+    # engines may override; the default pair makes push invalidation an
+    # optional capability (TTL expiry remains the correctness story)
+    def do_publish_invalidations(self, sid: int, events: list[tuple]) -> None:
+        pass
+
+    def do_fetch_invalidations(self, since: int, exclude_sid: int) -> tuple[int, list[tuple]]:
+        return since, []
 
     def on_msg(self, mtype: int, callback: Callable) -> None:
         """Register DELETE_SLICE / COMPACT_CHUNK callback
@@ -290,6 +388,7 @@ class BaseMeta(interface.Meta):
         st = self.do_set_facl(ctx, ino, acl_type, rule)
         if st == 0:
             self.of.invalidate(ino)
+            self._note_change(("a", ino))
         return st
 
     def get_facl(self, ctx: Context, ino: int, acl_type: int):
@@ -390,6 +489,7 @@ class BaseMeta(interface.Meta):
         st, out = self.do_setattr(ctx, ino, flags, attr)
         if st == 0:
             self.of.invalidate(ino)
+            self._note_change(("a", ino))
         return st, out
 
     def mknod(
@@ -411,7 +511,10 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st, 0, Attr()
-        return self.do_mknod(ctx, parent, name, typ, mode, cumask, rdev, path)
+        out = self.do_mknod(ctx, parent, name, typ, mode, cumask, rdev, path)
+        if out[0] == 0:
+            self._note_change(("e", parent, bytes(name)), ("a", parent))
+        return out
 
     def mkdir(self, ctx, parent, name, mode, cumask=0) -> tuple[int, int, Attr]:
         return self.mknod(ctx, parent, name, TYPE_DIRECTORY, mode, cumask)
@@ -439,7 +542,10 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st
-        return self.do_unlink(ctx, parent, name, skip_trash)
+        st = self.do_unlink(ctx, parent, name, skip_trash)
+        if st == 0:
+            self._note_change(("e", parent, bytes(name)), ("a", parent))
+        return st
 
     def rmdir(self, ctx, parent, name, skip_trash=False) -> int:
         if name == b"." :
@@ -449,7 +555,10 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st
-        return self.do_rmdir(ctx, parent, name, skip_trash)
+        st = self.do_rmdir(ctx, parent, name, skip_trash)
+        if st == 0:
+            self._note_change(("e", parent, bytes(name)), ("a", parent))
+        return st
 
     def rename(self, ctx, psrc, nsrc, pdst, ndst, flags=0) -> tuple[int, int, Attr]:
         st = self.check_name(ndst)
@@ -464,6 +573,10 @@ class BaseMeta(interface.Meta):
         st, ino, attr = self.do_rename(ctx, psrc, nsrc, pdst, ndst, flags)
         if st == 0:
             self.of.invalidate(ino)
+            self._note_change(
+                ("e", psrc, bytes(nsrc)), ("e", pdst, bytes(ndst)),
+                ("a", ino), ("a", psrc), ("a", pdst),
+            )
         return st, ino, attr
 
     def link(self, ctx, ino, parent, name) -> tuple[int, Attr]:
@@ -476,6 +589,7 @@ class BaseMeta(interface.Meta):
         st, attr = self.do_link(ctx, ino, parent, name)
         if st == 0:
             self.of.invalidate(ino)
+            self._note_change(("e", parent, bytes(name)), ("a", ino), ("a", parent))
         return st, attr
 
     def readdir(self, ctx, ino, want_attr: bool = False) -> tuple[int, list[Entry]]:
@@ -543,6 +657,8 @@ class BaseMeta(interface.Meta):
             return errno.EINVAL
         st = self.do_write_chunk(ino, indx, pos, slc, indx * CHUNK_SIZE + pos + slc.len)
         self.of.invalidate(ino)  # cached attr (length/mtime) and chunks are stale
+        if st == 0:
+            self._note_change(("a", ino))
         return st
 
     def truncate(self, ctx, ino, length, skip_perm=False) -> tuple[int, Attr]:
@@ -556,6 +672,7 @@ class BaseMeta(interface.Meta):
         st, attr = self.do_truncate(ctx, ino, length)
         if st == 0:
             self.of.invalidate(ino)
+            self._note_change(("a", ino))
         return st, attr
 
     def fallocate(self, ctx, ino, mode, off, size) -> int:
@@ -564,6 +681,7 @@ class BaseMeta(interface.Meta):
         st = self.do_fallocate(ctx, ino, mode, off, size)
         if st == 0:
             self.of.invalidate(ino)
+            self._note_change(("a", ino))
         return st
 
     def copy_file_range(
@@ -622,6 +740,11 @@ class BaseMeta(interface.Meta):
                 if st:
                     return st, copied
             copied += n
+        if copied:
+            # do_write_chunk was called directly (not via write_chunk), so
+            # the destination's caches are invalidated here
+            self.of.invalidate(fout)
+            self._note_change(("a", fout))
         return 0, copied
 
     # -- xattr -------------------------------------------------------------

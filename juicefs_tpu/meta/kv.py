@@ -1246,6 +1246,59 @@ class KVMeta(BaseMeta):
                 indx = int.from_bytes(k[10:14], "big")
                 yield (ino, indx), Slice.decode_list(v)
 
+    # ---- push invalidation (reference vfs.go:1228 / openfile.go) ---------
+    # IV{seq8} -> sid8 + ts f64 + JSON events. A small rolling journal:
+    # peers tail it on their heartbeat; stale records are pruned by
+    # publishers. Best-effort acceleration of the TTL contract.
+
+    _INVAL_TTL = 60.0
+
+    @staticmethod
+    def _inval_key(seq: int) -> bytes:
+        return b"IV" + seq.to_bytes(8, "big")
+
+    def do_publish_invalidations(self, sid: int, events: list[tuple]) -> None:
+        payload = self._encode_inval_events(events).encode()
+
+        def fn(tx: KVTxn):
+            seq = tx.incr_by(self._counter_key("invalSeq"), 1)
+            tx.set(self._inval_key(seq), sid.to_bytes(8, "big") + _F64.pack(time.time()) + payload)
+            return 0
+
+        self.client.txn(fn)
+        # prune aged records (journal stays tiny; the ordered scan stops at
+        # the first FRESH record — malformed ones are doomed, not treated
+        # as fresh, so one bad record cannot block pruning forever)
+        cutoff = time.time() - self._INVAL_TTL
+        doomed = []
+        for k, v in self.client.scan(b"IV", next_key(b"IV")):
+            if len(v) < 16 or _F64.unpack_from(v, 8)[0] < cutoff:
+                doomed.append(k)
+            else:
+                break
+        if doomed:
+            def prune(tx: KVTxn):
+                for k in doomed:
+                    tx.delete(k)
+                return 0
+
+            self.client.txn(prune)
+
+    def do_fetch_invalidations(self, since: int, exclude_sid: int) -> tuple[int, list[tuple]]:
+        if since < 0:
+            # first heartbeat: learn the current position, deliver nothing
+            return self.do_counter("invalSeq"), []
+        events: list[tuple] = []
+        latest = since
+        for k, v in self.client.scan(self._inval_key(since + 1), next_key(b"IV")):
+            if len(k) != 10 or len(v) < 16:
+                continue
+            latest = max(latest, int.from_bytes(k[2:10], "big"))
+            if int.from_bytes(v[:8], "big") == exclude_sid:
+                continue
+            events.extend(self._decode_inval_events(v[16:]))
+        return latest, events
+
     # ---- content-hash index (TPU fingerprint plane) ----------------------
     # Persists the write path's JTH-256 block digests so gc --dedup and
     # fsck consume an index instead of re-hashing the volume. The index is

@@ -129,6 +129,9 @@ CREATE TABLE IF NOT EXISTS blockdigest (
     sliceid INTEGER NOT NULL, indx INTEGER NOT NULL,
     bsize INTEGER NOT NULL, digest BLOB NOT NULL,
     PRIMARY KEY (sliceid, indx));
+CREATE TABLE IF NOT EXISTS invalidation (
+    seq INTEGER PRIMARY KEY, sid INTEGER NOT NULL,
+    ts REAL NOT NULL, events TEXT NOT NULL);
 """
 
 _NODE_COLS = (
@@ -1384,6 +1387,41 @@ class SQLMeta(BaseMeta):
             slcs.append(Slice(pos=pos, id=sid, size=size, off=off, len=ln))
         if cur_key is not None:
             yield cur_key, slcs
+
+    # ---- push invalidation (reference vfs.go:1228 / openfile.go) ---------
+    _INVAL_TTL = 60.0
+
+    def do_publish_invalidations(self, sid: int, events: list[tuple]) -> None:
+        payload = self._encode_inval_events(events)
+
+        def fn(cur):
+            seq = self._incr_counter(cur, "invalSeq", 1)
+            cur.execute(
+                "INSERT OR REPLACE INTO invalidation (seq, sid, ts, events) "
+                "VALUES (?,?,?,?)",
+                (seq, sid, time.time(), payload),
+            )
+            cur.execute("DELETE FROM invalidation WHERE ts < ?",
+                        (time.time() - self._INVAL_TTL,))
+            return 0
+
+        self._txn(fn)
+
+    def do_fetch_invalidations(self, since: int, exclude_sid: int) -> tuple[int, list[tuple]]:
+        if since < 0:
+            return self._rtxn(lambda cur: self._counter(cur, "invalSeq")), []
+        rows = self._rtxn(lambda cur: cur.execute(
+            "SELECT seq, sid, events FROM invalidation WHERE seq > ? "
+            "ORDER BY seq", (since,)
+        ).fetchall())
+        events: list[tuple] = []
+        latest = since
+        for seq, sid, raw in rows:
+            latest = max(latest, seq)
+            if sid == exclude_sid:
+                continue
+            events.extend(self._decode_inval_events(raw))
+        return latest, events
 
     # ---- content-hash index (TPU fingerprint plane) ----------------------
     def set_block_digests(self, entries: list[tuple[int, int, int, bytes]]) -> None:
