@@ -83,6 +83,8 @@ OPCODE_NAMES = {
 
 # init flags (subset we care about)
 FUSE_ASYNC_READ = 1 << 0
+FUSE_POSIX_LOCKS = 1 << 1
+FUSE_FLOCK_LOCKS = 1 << 10
 FUSE_BIG_WRITES = 1 << 5
 FUSE_DONT_MASK = 1 << 6
 FUSE_AUTO_INVAL_DATA = 1 << 12
@@ -92,6 +94,9 @@ FUSE_PARALLEL_DIROPS = 1 << 18
 FUSE_POSIX_ACL = 1 << 20
 FUSE_MAX_PAGES = 1 << 22
 FUSE_INIT_EXT = 1 << 30
+
+FUSE_LK_FLOCK = 1 << 0  # lk_flags: request is a BSD flock, not fcntl
+FUSE_RELEASE_FLOCK_UNLOCK = 1 << 1  # release_flags
 
 IN_HEADER = struct.Struct("<IIQQIIII")  # len opcode unique nodeid uid gid pid pad
 OUT_HEADER = struct.Struct("<IiQ")  # len error unique

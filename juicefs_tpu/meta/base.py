@@ -184,13 +184,23 @@ class BaseMeta(interface.Meta):
         """Register a client session (reference base.go:371 NewSession)."""
         if record:
             self.sid = self.do_new_session(new_session_info())
+            self.do_watch_unlocks()
             if heartbeat > 0:
                 self.start_heartbeat(heartbeat)
         return self.sid
 
+    def do_watch_unlocks(self) -> None:
+        """Engines with a push channel subscribe to peers' unlock events so
+        remote SETLKW waiters wake without polling (reference
+        redis_lock.go wakes cross-client through the engine). Default:
+        no push channel — the poll cadence covers."""
+
     def start_heartbeat(self, interval: float) -> None:
         """Refresh an (already set) session id periodically — also used
-        after a seamless-upgrade takeover adopts the predecessor's sid."""
+        after a seamless-upgrade takeover adopts the predecessor's sid
+        (which skips new_session, so the unlock watcher is armed here
+        too; engines make it idempotent)."""
+        self.do_watch_unlocks()
         self._heartbeat = threading.Thread(
             target=self._session_refresher, args=(interval,), daemon=True
         )
@@ -267,6 +277,11 @@ class BaseMeta(interface.Meta):
     def _exchange_invalidations(self) -> None:
         with self._inval_mu:
             batch, self._inval_buf = self._inval_buf, []
+        if batch:
+            # dedup: a busy writer notes the same ("a", ino) per chunk
+            # write; peers would otherwise replay thousands of identical
+            # kernel notifies per beat
+            batch = list(dict.fromkeys(batch))
         if batch:
             try:
                 self.do_publish_invalidations(self.sid, batch)
@@ -698,13 +713,23 @@ class BaseMeta(interface.Meta):
             return 0, 0
         size = min(size, attr.length - offin)
         copied = 0
+
+        def _done(st: int):
+            if copied:
+                # do_write_chunk was called directly (not via write_chunk):
+                # the destination's caches are invalidated on EVERY exit
+                # that mutated it, including partial-failure returns
+                self.of.invalidate(fout)
+                self._note_change(("a", fout))
+            return st, copied
+
         while copied < size:
             indx = (offin + copied) // CHUNK_SIZE
             pos = (offin + copied) % CHUNK_SIZE
             n = min(CHUNK_SIZE - pos, size - copied)
             st, slices = self.do_read_chunk(fin, indx)
             if st:
-                return st, copied
+                return _done(st)
             from .slice import build_slice
 
             view = build_slice(slices)
@@ -732,20 +757,15 @@ class BaseMeta(interface.Meta):
                     dindx * CHUNK_SIZE + new.pos + new.len, incref=True,
                 )
                 if st:
-                    return st, copied
+                    return _done(st)
                 cur = s1
             if cur < end:  # trailing hole
                 hole = Slice(pos=dpos + (cur - pos), id=0, size=end - cur, off=0, len=end - cur)
                 st = self.do_write_chunk(fout, dindx, hole.pos, hole, dindx * CHUNK_SIZE + hole.pos + hole.len)
                 if st:
-                    return st, copied
+                    return _done(st)
             copied += n
-        if copied:
-            # do_write_chunk was called directly (not via write_chunk), so
-            # the destination's caches are invalidated here
-            self.of.invalidate(fout)
-            self._note_change(("a", fout))
-        return 0, copied
+        return _done(0)
 
     # -- xattr -------------------------------------------------------------
     def getxattr(self, ctx, ino, name: bytes) -> tuple[int, bytes]:

@@ -1852,7 +1852,33 @@ class KVMeta(BaseMeta):
         st = self._etxn(fn)
         if st == 0 and ltype == "U":
             self.lock_released(ino)
+            self._publish_unlock(ino)
         return st
+
+    # -- cross-client lock wake (reference redis_lock.go; VERDICT r3 #9) ---
+    _UNLOCK_CHANNEL = b"jfs:unlock"
+
+    def _publish_unlock(self, ino: int) -> None:
+        pub = getattr(self.client, "publish", None)
+        if pub is not None:
+            pub(self._UNLOCK_CHANNEL, str(ino).encode())
+
+    def do_watch_unlocks(self) -> None:
+        sub = getattr(self.client, "subscribe", None)
+        if sub is None or getattr(self, "_watching_unlocks", False):
+            return
+        self._watching_unlocks = True
+
+        def on_msg(payload: bytes) -> None:
+            try:
+                ino = int(payload)
+            except ValueError:
+                return
+            # wake local waiters parked in lock_wait on this inode; they
+            # re-contend through the normal setlk/flock path
+            self.lock_released(ino)
+
+        sub(self._UNLOCK_CHANNEL, on_msg)
 
     def setlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int, pid: int = 0) -> int:
         """POSIX record lock set/unset; non-blocking (reference Setlk)."""
@@ -1895,6 +1921,7 @@ class KVMeta(BaseMeta):
         st = self._etxn(fn)
         if st == 0 and ltype == self.F_UNLCK:
             self.lock_released(ino)
+            self._publish_unlock(ino)
         return st
 
     def getlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int) -> tuple[int, int, int, int, int]:

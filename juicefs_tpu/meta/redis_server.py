@@ -59,6 +59,10 @@ class RedisServer:
         self.host, self.port = host, port
         self.dbs = [_DB() for _ in range(n_dbs)]
         self.lock = threading.RLock()
+        # pub/sub (SUBSCRIBE/PUBLISH subset): channel -> live subscriber
+        # conns. Ephemeral — never AOF'd. Powers cross-client lock wake
+        # (VERDICT r3 #9) and any future push channel.
+        self.subscribers: dict[bytes, set] = {}
         self._srv: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.data_path = data_path
@@ -284,6 +288,8 @@ class _Conn:
         self.in_multi = False
         self.queue: list[list[bytes]] = []
         self.multi_err = False
+        self.subscribed: set[bytes] = set()
+        self.wlock = threading.Lock()  # replies vs async pub/sub pushes
 
     # ---- RESP ------------------------------------------------------------
     def _read_cmd(self) -> Optional[list[bytes]]:
@@ -305,12 +311,29 @@ class _Conn:
         return parts
 
     def _send(self, payload: bytes) -> None:
-        self.sock.sendall(payload)
+        with self.wlock:
+            self.sock.sendall(payload)
+
+    def _send_push(self, payload: bytes) -> None:
+        """Async pub/sub push with a send timeout: a subscriber with a
+        full receive buffer is dropped, not waited on."""
+        with self.wlock:
+            old = self.sock.gettimeout()
+            self.sock.settimeout(1.0)
+            try:
+                self.sock.sendall(payload)
+            finally:
+                try:
+                    self.sock.settimeout(old)
+                except OSError:
+                    pass
 
     @staticmethod
     def _enc(obj) -> bytes:
         if obj is None:
             return b"$-1\r\n"
+        if isinstance(obj, _Raw):
+            return obj.payload
         if isinstance(obj, _Err):
             return b"-" + obj.msg.encode() + b"\r\n"
         if isinstance(obj, _Status):
@@ -343,6 +366,13 @@ class _Conn:
         except (ConnectionError, ValueError, OSError):
             pass
         finally:
+            with self.server.lock:
+                for ch in self.subscribed:
+                    conns = self.server.subscribers.get(ch)
+                    if conns is not None:
+                        conns.discard(self)
+                        if not conns:
+                            self.server.subscribers.pop(ch, None)
             try:
                 self.sock.close()
             except OSError:
@@ -361,6 +391,42 @@ class _Conn:
     # ---- commands --------------------------------------------------------
     def cmd_ping(self, args):
         return _Status("PONG") if not args else args[0]
+
+    # ---- pub/sub (ephemeral; reference redis pub/sub subset) -------------
+    def cmd_subscribe(self, args):
+        out = []
+        for ch in args:
+            self.server.subscribers.setdefault(ch, set()).add(self)
+            self.subscribed.add(ch)
+            out.append(_Raw(_Conn._enc([b"subscribe", ch, len(self.subscribed)])))
+        return _Raw(b"".join(r.payload for r in out))
+
+    def cmd_unsubscribe(self, args):
+        out = b""
+        for ch in (args or list(self.subscribed)):
+            conns = self.server.subscribers.get(ch)
+            if conns is not None:
+                conns.discard(self)
+                if not conns:
+                    self.server.subscribers.pop(ch, None)
+            self.subscribed.discard(ch)
+            out += _Conn._enc([b"unsubscribe", ch, len(self.subscribed)])
+        return _Raw(out)
+
+    def cmd_publish(self, args):
+        ch, msg = args[0], args[1]
+        conns = list(self.server.subscribers.get(ch, ()))
+        push = _Conn._enc([b"message", ch, msg])
+        delivered = 0
+        for c in conns:
+            try:
+                # bounded send: dispatch holds the global server lock, so a
+                # stalled subscriber must never block the whole meta server
+                c._send_push(push)
+                delivered += 1
+            except OSError:
+                self.server.subscribers.get(ch, set()).discard(c)
+        return delivered
 
     def cmd_echo(self, args):
         return args[0]
@@ -526,6 +592,13 @@ class _Conn:
             finally:
                 self.server.aof_txn_end()
             return out
+
+
+class _Raw:
+    """Pre-encoded RESP payload (pub/sub confirmations are multi-reply)."""
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
 
 
 class _Status:

@@ -473,3 +473,102 @@ def test_cross_mount_kernel_invalidation(tmp_path):
                 m.close_session()
             except Exception:
                 pass
+
+
+def test_cross_mount_lock_conflict_and_wake(tmp_path):
+    """FUSE_POSIX_LOCKS/FLOCK_LOCKS negotiation (VERDICT r3 #9 kernel
+    half): without them the kernel keeps locks per-superblock and two
+    mounts of one volume never conflict. With them, fcntl and flock
+    conflict across mounts, and a blocked waiter wakes on the remote
+    unlock via the meta push channel far faster than the poll fallback."""
+    import fcntl
+    import threading
+
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.fuse import Server
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.meta.redis_server import RedisServer
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.vfs import VFS
+
+    rsrv = RedisServer()
+    port = rsrv.start()
+    meta_url = f"redis://127.0.0.1:{port}/0"
+    c0 = new_client(meta_url)
+    c0.init(Format(name="lockmnt", trash_days=0), force=True)
+
+    mounts = []
+    try:
+        for name in ("a", "b"):
+            m = new_client(meta_url)
+            m.load()
+            m.new_session()
+            store = CachedStore(create_storage(f"file://{tmp_path}/blob"),
+                                ChunkConfig(block_size=1 << 18))
+            v = VFS(m, store)
+            mp = tmp_path / f"mnt-{name}"
+            mp.mkdir()
+            srv = Server(v, str(mp))
+            try:
+                srv.serve_background()
+            except OSError as e:
+                pytest.skip(f"cannot mount: {e}")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                try:
+                    os.statvfs(mp)
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            mounts.append((str(mp), srv, v, m))
+        mp_a, mp_b = mounts[0][0], mounts[1][0]
+
+        fa = os.open(os.path.join(mp_a, "f"), os.O_CREAT | os.O_RDWR, 0o644)
+        fb = os.open(os.path.join(mp_b, "f"), os.O_RDWR)
+        try:
+            # fcntl: conflicts across mounts
+            fcntl.lockf(fa, fcntl.LOCK_EX)
+            with pytest.raises(BlockingIOError):
+                fcntl.lockf(fb, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            # blocked waiter wakes on the remote unlock via push
+            got = {}
+
+            def blocked():
+                t0 = time.perf_counter()
+                fcntl.lockf(fb, fcntl.LOCK_EX)
+                got["dt"] = time.perf_counter() - t0
+
+            t = threading.Thread(target=blocked)
+            t.start()
+            time.sleep(0.4)
+            fcntl.lockf(fa, fcntl.LOCK_UN)
+            t.join(5)
+            assert not t.is_alive(), "blocked fcntl waiter never woke"
+            wake = got["dt"] - 0.4
+            assert wake < 0.25, f"wake took {wake*1000:.0f}ms (poll is 250ms)"
+            fcntl.lockf(fb, fcntl.LOCK_UN)
+
+            # flock: conflicts across mounts too
+            fcntl.flock(fa, fcntl.LOCK_EX)
+            with pytest.raises(BlockingIOError):
+                fcntl.flock(fb, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            fcntl.flock(fa, fcntl.LOCK_UN)
+            fcntl.flock(fb, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            fcntl.flock(fb, fcntl.LOCK_UN)
+        finally:
+            os.close(fa)
+            os.close(fb)
+    finally:
+        for _mp, srv, v, m in mounts:
+            try:
+                srv.unmount()
+            except Exception:
+                pass
+        time.sleep(0.1)
+        for _mp, srv, v, m in mounts:
+            try:
+                v.close()
+                m.close_session()
+            except Exception:
+                pass
+        rsrv.stop()

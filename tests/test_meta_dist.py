@@ -396,3 +396,40 @@ def test_push_invalidation_beats_ttl(server, tmp_path):
         vb.close()
         va.meta.close_session()
         vb.meta.close_session()
+
+
+def test_cross_client_lock_wake_via_push(pair):
+    """VERDICT r3 #9: a remote client's unlock wakes a blocked waiter
+    through the engine's push channel — wake latency is far below any
+    poll cadence (the waiter parks for 5s and must return in ~ms)."""
+    c1, c2 = pair
+    _, ino, _ = c1.create(CTX, ROOT_INODE, b"locked", 0o644)
+    c1.close(CTX, ino)
+
+    assert c1.setlk(CTX, ino, owner=1, ltype=c1.F_WRLCK, start=0, end=100) == 0
+    # c2 contends and parks (exactly what the SETLKW loop does)
+    assert c2.setlk(CTX, ino, owner=2, ltype=c2.F_WRLCK, start=0, end=100) == errno.EAGAIN
+    gen = c2.lock_generation(ino)
+
+    woke = {}
+
+    def waiter():
+        t0 = time.perf_counter()
+        c2.lock_wait(ino, 5.0, gen)   # 5s poll fallback: only push can win
+        woke["dt"] = time.perf_counter() - t0
+        woke["st"] = c2.setlk(CTX, ino, owner=2, ltype=c2.F_WRLCK,
+                              start=0, end=100)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)  # let the waiter park
+    assert c1.setlk(CTX, ino, owner=1, ltype=c1.F_UNLCK, start=0, end=100) == 0
+    t.join(6)
+    assert not t.is_alive()
+    assert woke["st"] == 0, "waiter could not take the lock after wake"
+    # parked 0.2s before the unlock; the wake itself must be ~instant
+    assert woke["dt"] < 1.0, (
+        f"waiter slept {woke['dt']:.2f}s — push wake never arrived "
+        f"(poll fallback was 5s)"
+    )
+    assert c2.setlk(CTX, ino, owner=2, ltype=c2.F_UNLCK, start=0, end=100) == 0
