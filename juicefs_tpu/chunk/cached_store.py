@@ -127,10 +127,19 @@ class CachedStore:
                 staged = self._pending_staged.get(key)
             if staged is not None:
                 return staged
-            data = self._with_retry(f"GET {key}", lambda: self.storage.get(key))
-            raw = self.compressor.decompress(data, bsize)
-            if len(raw) != bsize:
-                raise IOError(f"block {key}: expect {bsize} bytes, got {len(raw)}")
+
+            def fetch() -> bytes:
+                data = self.storage.get(key)
+                raw = self.compressor.decompress(data, bsize)
+                if len(raw) != bsize:
+                    # short/over-long response (flaky backend, truncated
+                    # transfer): retryable, NOT a permanent failure
+                    raise IOError(
+                        f"block {key}: expect {bsize} bytes, got {len(raw)}"
+                    )
+                return raw
+
+            raw = self._with_retry(f"GET {key}", fetch)
             if cache_after:
                 self.cache.cache(key, raw)
             return raw
@@ -445,9 +454,18 @@ class RSlice:
                     if staged is not None:
                         out += staged[boff : boff + n]
                     else:
+                        def ranged(k=key, o=boff, ln=n) -> bytes:
+                            data = self.store.storage.get(k, o, ln)
+                            if len(data) != ln:
+                                # short read: retry, never return torn data
+                                raise IOError(
+                                    f"ranged GET {k}[{o}:{o+ln}]: got "
+                                    f"{len(data)} bytes"
+                                )
+                            return data
+
                         out += self.store._with_retry(
-                            f"GET {key}[{boff}:{boff+n}]",
-                            lambda k=key, o=boff, ln=n: self.store.storage.get(k, o, ln),
+                            f"GET {key}[{boff}:{boff+n}]", ranged
                         )
                 else:
                     raw = self.store._load_block(key, bsize)

@@ -713,9 +713,10 @@ class BaseMeta(interface.Meta):
             return 0, 0
         size = min(size, attr.length - offin)
         copied = 0
+        wrote = False
 
         def _done(st: int):
-            if copied:
+            if copied or wrote:
                 # do_write_chunk was called directly (not via write_chunk):
                 # the destination's caches are invalidated on EVERY exit
                 # that mutated it, including partial-failure returns
@@ -758,12 +759,14 @@ class BaseMeta(interface.Meta):
                 )
                 if st:
                     return _done(st)
+                wrote = True
                 cur = s1
             if cur < end:  # trailing hole
                 hole = Slice(pos=dpos + (cur - pos), id=0, size=end - cur, off=0, len=end - cur)
                 st = self.do_write_chunk(fout, dindx, hole.pos, hole, dindx * CHUNK_SIZE + hole.pos + hole.len)
                 if st:
                     return _done(st)
+                wrote = True
             copied += n
         return _done(0)
 

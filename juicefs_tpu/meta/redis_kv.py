@@ -408,10 +408,13 @@ class RedisKV(TKVClient):
             while not stop.is_set():
                 conn = None
                 try:
+                    # stash so close() can sever a listener parked in
+                    # read_reply(timeout=None)
                     # timeout=None: pub/sub channels are mostly idle; the
                     # default 30s recv timeout would churn a reconnect (and
                     # a deaf window) every 30s forever
-                    conn = RespConnection(self.host, self.port, timeout=None)
+                    conn = self._sub_conn = RespConnection(
+                        self.host, self.port, timeout=None)
                     conn.send((b"SUBSCRIBE", channel))
                     conn.read_reply()
                     while not stop.is_set():
@@ -437,6 +440,10 @@ class RedisKV(TKVClient):
         stop = getattr(self, "_sub_stop", None)
         if stop is not None:
             stop.set()
+        sub = getattr(self, "_sub_conn", None)
+        if sub is not None:
+            sub.close()  # unblocks the listener's read_reply
+            self._sub_conn = None
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             conn.close()

@@ -417,16 +417,21 @@ class _Conn:
         ch, msg = args[0], args[1]
         conns = list(self.server.subscribers.get(ch, ()))
         push = _Conn._enc([b"message", ch, msg])
-        delivered = 0
-        for c in conns:
-            try:
-                # bounded send: dispatch holds the global server lock, so a
-                # stalled subscriber must never block the whole meta server
-                c._send_push(push)
-                delivered += 1
-            except OSError:
-                self.server.subscribers.get(ch, set()).discard(c)
-        return delivered
+        if conns:
+            # deliver OFF the dispatch path: dispatch holds the global
+            # server lock, and even a bounded send to a stalled subscriber
+            # would freeze every meta operation for the timeout
+            def deliver(conns=conns, push=push, ch=ch):
+                for c in conns:
+                    try:
+                        c._send_push(push)
+                    except OSError:
+                        with self.server.lock:
+                            self.server.subscribers.get(ch, set()).discard(c)
+
+            threading.Thread(target=deliver, daemon=True,
+                             name="pubsub-deliver").start()
+        return len(conns)
 
     def cmd_echo(self, args):
         return args[0]
