@@ -403,18 +403,26 @@ class RedisKV(TKVClient):
         stop = getattr(self, "_sub_stop", None)
         if stop is None:
             stop = self._sub_stop = threading.Event()
+        if not hasattr(self, "_sub_conns"):
+            self._sub_conns: list = []
+            self._sub_mu = threading.Lock()
 
         def loop():
             while not stop.is_set():
                 conn = None
                 try:
-                    # stash so close() can sever a listener parked in
-                    # read_reply(timeout=None)
                     # timeout=None: pub/sub channels are mostly idle; the
                     # default 30s recv timeout would churn a reconnect (and
-                    # a deaf window) every 30s forever
-                    conn = self._sub_conn = RespConnection(
-                        self.host, self.port, timeout=None)
+                    # a deaf window) every 30s forever. Registered under a
+                    # lock so close() can sever EVERY parked listener, and
+                    # re-checked after registration to close the race with
+                    # a concurrent close().
+                    conn = RespConnection(self.host, self.port, timeout=None)
+                    with self._sub_mu:
+                        self._sub_conns.append(conn)
+                    if stop.is_set():
+                        conn.close()
+                        return
                     conn.send((b"SUBSCRIBE", channel))
                     conn.read_reply()
                     while not stop.is_set():
@@ -431,6 +439,9 @@ class RedisKV(TKVClient):
                 finally:
                     if conn is not None:
                         conn.close()
+                        with self._sub_mu:
+                            if conn in self._sub_conns:
+                                self._sub_conns.remove(conn)
 
         t = threading.Thread(target=loop, daemon=True,
                              name=f"sub-{channel.decode(errors='replace')}")
@@ -440,10 +451,11 @@ class RedisKV(TKVClient):
         stop = getattr(self, "_sub_stop", None)
         if stop is not None:
             stop.set()
-        sub = getattr(self, "_sub_conn", None)
-        if sub is not None:
-            sub.close()  # unblocks the listener's read_reply
-            self._sub_conn = None
+        if hasattr(self, "_sub_conns"):
+            with self._sub_mu:
+                subs, self._sub_conns = list(self._sub_conns), []
+            for c in subs:
+                c.close()  # unblocks listeners parked in read_reply
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             conn.close()

@@ -38,6 +38,8 @@ class FaultyStore(ObjectStorage):
     short_reads   probability that get() returns a truncated payload
     """
 
+    _KEEP = object()  # fault_config sentinel: leave the setting unchanged
+
     def __init__(self, store: ObjectStorage, error_rate: float = 0.0,
                  get_error_rate: float | None = None,
                  put_error_rate: float | None = None,
@@ -47,19 +49,28 @@ class FaultyStore(ObjectStorage):
         self._rng = random.Random(seed)
         self._mu = threading.Lock()
         self.counters = {"errors": 0, "short_reads": 0, "delayed": 0}
-        self.fault_config(error_rate, get_error_rate, put_error_rate,
-                          latency, short_reads)
-
-    def fault_config(self, error_rate: float = 0.0,
-                     get_error_rate: float | None = None,
-                     put_error_rate: float | None = None,
-                     latency: float = 0.0, short_reads: float = 0.0) -> None:
-        """Reconfigure live (drills heal or worsen the store mid-run)."""
         self.error_rate = error_rate
         self.get_error_rate = get_error_rate
         self.put_error_rate = put_error_rate
         self.latency = latency
         self.short_reads = short_reads
+
+    def fault_config(self, error_rate=_KEEP, get_error_rate=_KEEP,
+                     put_error_rate=_KEEP, latency=_KEEP,
+                     short_reads=_KEEP) -> None:
+        """Reconfigure live (drills heal or worsen the store mid-run).
+        Unspecified settings KEEP their current values — a partial call
+        never silently resets the rest of the fault profile."""
+        if error_rate is not self._KEEP:
+            self.error_rate = error_rate
+        if get_error_rate is not self._KEEP:
+            self.get_error_rate = get_error_rate
+        if put_error_rate is not self._KEEP:
+            self.put_error_rate = put_error_rate
+        if latency is not self._KEEP:
+            self.latency = latency
+        if short_reads is not self._KEEP:
+            self.short_reads = short_reads
 
     # -- fault engine -------------------------------------------------------
     def _maybe_fail(self, op: str, rate: float | None) -> None:

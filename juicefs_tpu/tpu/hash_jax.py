@@ -24,11 +24,14 @@ from jax import lax
 from .jth256 import (
     COLS,
     IV,
+    LANE_BYTES,
     ROWS,
     digests_to_bytes,
     pack_blocks,
 )
-from . import jth256 as _spec
+# the reference hash FUNCTION (the package re-exports the name `jth256`,
+# shadowing the submodule attribute — import the callable directly)
+from .jth256 import jth256 as _jth256_ref
 
 # Plain ints here: wrapping them in jnp.uint32 at module scope would
 # initialize a JAX backend at import time, breaking accelerator-free
@@ -137,7 +140,9 @@ def hash_packed_jax(
 # Pallas path: one grid step = one lane tile resident in VMEM.
 # ---------------------------------------------------------------------------
 
-_LANE_GROUP = 8  # lanes per grid step; makes the (8,128) output block tileable
+_LANE_GROUP = 16  # lanes per grid step (16 x 64 KiB in VMEM); measured
+# fastest on v5e: 8 -> 108 GiB/s, 16 -> 118/183 GiB/s (16/32 GiB scans),
+# 32 -> 110 GiB/s. The output block stays (16,128)-tileable.
 
 # Pallas execution-mode control (VERDICT r2 weak #2: the interpret fallback
 # must never be silent). None = auto (compiled iff default backend is TPU);
@@ -167,18 +172,27 @@ def last_pallas_mode() -> str | None:
 
 
 def _pallas_row_chain(
-    words_flat: jax.Array, m: int, unroll: int = 8, interpret: bool = False
+    words_flat: jax.Array, m: int, tweak: jax.Array, unroll: int = 8,
+    interpret: bool = False, lane_group: int | None = None,
 ) -> jax.Array:
     """words_flat (L, 128, 128) -> lane states (L, 128); L = B*M lanes.
 
-    One grid step keeps 8 lane tiles (8 x 64 KiB) resident in VMEM and runs
-    their row chains together; the Pallas pipeline double-buffers the
-    HBM->VMEM streaming across grid steps.
+    One grid step keeps `lane_group` lane tiles (x 64 KiB) resident in
+    VMEM and runs their row chains together; the Pallas pipeline
+    double-buffers the HBM->VMEM streaming across grid steps.
+
+    `tweak` (uint32 (1,)) is xor'ed into every word INSIDE the kernel —
+    benchmark loops vary it per iteration to defeat dispatch elision
+    without materializing a tweaked copy of the batch in HBM (the copy
+    was round 3's pallas handicap: pallas_call is opaque to XLA fusion,
+    so `words ^ k` cost one extra HBM write+read per pass).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    def kernel(w_ref, out_ref):
+    group = lane_group or _LANE_GROUP
+
+    def kernel(t_ref, w_ref, out_ref):
         # Constants are rebuilt from Python ints here: a pallas kernel may
         # not close over device arrays created outside the trace.
         p1, p2, p3, p5 = (
@@ -187,15 +201,16 @@ def _pallas_row_chain(
             jnp.uint32(0xC2B2AE3D),
             jnp.uint32(0x165667B1),
         )
+        tw = t_ref[0]
         i = pl.program_id(0)
-        u8 = jax.lax.broadcasted_iota(jnp.uint32, (_LANE_GROUP, 1), 0)
-        lane = jax.lax.rem(jnp.uint32(i * _LANE_GROUP) + u8, jnp.uint32(m))
-        j = jax.lax.broadcasted_iota(jnp.uint32, (_LANE_GROUP, COLS), 1)
+        u8 = jax.lax.broadcasted_iota(jnp.uint32, (group, 1), 0)
+        lane = jax.lax.rem(jnp.uint32(i * group) + u8, jnp.uint32(m))
+        j = jax.lax.broadcasted_iota(jnp.uint32, (group, COLS), 1)
         s = p5 ^ (j * p1) ^ (lane * p3)
 
         def body(r, s):
             for u in range(unroll):
-                w = w_ref[:, r * unroll + u, :]
+                w = w_ref[:, r * unroll + u, :] ^ tw
                 s = (s ^ w) * p1
                 s = ((s << jnp.uint32(13)) | (s >> jnp.uint32(19))) * p2
                 s = s ^ (s >> jnp.uint32(15))
@@ -204,7 +219,7 @@ def _pallas_row_chain(
         out_ref[:, :] = jax.lax.fori_loop(0, ROWS // unroll, body, s)
 
     n_lanes = words_flat.shape[0]
-    padded = -(-n_lanes // _LANE_GROUP) * _LANE_GROUP
+    padded = -(-n_lanes // group) * group
     if padded != n_lanes:
         # Pad with zero lanes; their states are computed and discarded.
         words_flat = jnp.concatenate(
@@ -213,27 +228,30 @@ def _pallas_row_chain(
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((padded, COLS), jnp.uint32),
-        grid=(padded // _LANE_GROUP,),
+        grid=(padded // group,),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(
-                (_LANE_GROUP, ROWS, COLS),
+                (group, ROWS, COLS),
                 lambda i: (i, 0, 0),
                 memory_space=pltpu.VMEM,
-            )
+            ),
         ],
-        out_specs=pl.BlockSpec((_LANE_GROUP, COLS), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((group, COLS), lambda i: (i, 0)),
         interpret=interpret,
-    )(words_flat)
+    )(tweak, words_flat)
     return out[:n_lanes]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "lane_group"))
 def _hash_packed_pallas_impl(
-    words: jax.Array, lane_counts: jax.Array, lengths: jax.Array, interpret: bool
+    words: jax.Array, lane_counts: jax.Array, lengths: jax.Array,
+    tweak: jax.Array, interpret: bool, lane_group: int | None = None,
 ) -> jax.Array:
     b, m = words.shape[0], words.shape[1]
     s = _pallas_row_chain(
-        words.reshape(b * m, ROWS, COLS), m, interpret=interpret
+        words.reshape(b * m, ROWS, COLS), m, tweak, interpret=interpret,
+        lane_group=lane_group,
     ).reshape(b, m, COLS)
     return _finish(s, lane_counts, lengths)
 
@@ -243,16 +261,27 @@ def hash_packed_pallas(
     lane_counts: jax.Array,
     lengths: jax.Array,
     interpret: bool | None = None,
+    tweak: jax.Array | None = None,
+    lane_group: int | None = None,
 ) -> jax.Array:
     """Pallas path: (B, M, 128, 128) uint32 -> (B, 8) uint32 digests.
 
     interpret=None resolves via pallas_interpret_active(); the resolved mode
     is recorded for last_pallas_mode() so callers can assert a compiled run.
+    tweak xors a scalar into every input word inside the kernel (bench
+    elision-defeat without an HBM copy); None/0 hashes the words as-is.
     """
     global _LAST_PALLAS_MODE
     mode = pallas_interpret_active() if interpret is None else interpret
     _LAST_PALLAS_MODE = "interpret" if mode else "compiled"
-    return _hash_packed_pallas_impl(words, lane_counts, lengths, interpret=mode)
+    if tweak is None:
+        tweak = jnp.zeros((1,), jnp.uint32)
+    else:
+        tweak = tweak.reshape((1,)).astype(jnp.uint32)
+    return _hash_packed_pallas_impl(
+        words, lane_counts, lengths, tweak, interpret=mode,
+        lane_group=lane_group,
+    )
 
 
 _IMPLS = {"xla": hash_packed_jax, "pallas": hash_packed_pallas}
@@ -284,8 +313,8 @@ def verify_backend(impl: str = "xla", seed: int = 0) -> bool:
     rng = np.random.default_rng(seed)
     blocks = [
         rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
-        for n in (0, 1, 100, _spec.LANE_BYTES, _spec.LANE_BYTES + 7, 3 * _spec.LANE_BYTES)
+        for n in (0, 1, 100, LANE_BYTES, LANE_BYTES + 7, 3 * LANE_BYTES)
     ]
     dev = hash_blocks_jax(blocks, impl=impl)
-    ref = [_spec.jth256(b) for b in blocks]
+    ref = [_jth256_ref(b) for b in blocks]
     return dev == ref
