@@ -83,15 +83,24 @@ def test_short_reads_never_surface_torn_data():
     store.flush_all()
     store.cache = __import__("juicefs_tpu.chunk.mem_cache",
                              fromlist=["MemCache"]).MemCache(0)
-    # many small ranged reads (the short-read-prone path) + full sweeps
+    # many small ranged reads (the short-read-prone path): a read either
+    # succeeds EXACTLY or fails loudly after exhausting retries (at 50%
+    # injection, 10 consecutive shorts do happen) — torn data never
     rng = random.Random(4)
+    ok_reads = 0
     for _ in range(40):
         off = rng.randrange(0, len(blob) - 1)
         n = rng.randrange(1, 5000)
-        st, got = v.read(CTX, ino, fh, off, n)
+        try:
+            st, got = v.read(CTX, ino, fh, off, n)
+        except OSError:
+            continue  # retries exhausted honestly: acceptable, never torn
         assert st == 0
         assert bytes(got) == blob[off:off + len(got)]
         assert len(got) == min(n, len(blob) - off), "short read surfaced"
+        ok_reads += 1
+    assert ok_reads > 10, "nearly every read exhausted retries"
+    faulty.fault_config(short_reads=0.0)  # heal: the data must be intact
     st, got = v.read(CTX, ino, fh, 0, len(blob))
     assert st == 0 and bytes(got) == blob
     assert faulty.counters["short_reads"] > 0, "no short reads injected"
