@@ -49,7 +49,10 @@ def storage_for(fmt: Format):
     if fmt.encrypt_key:
         from ..object import new_encrypted
 
-        store = new_encrypted(store, fmt.encrypt_key.encode())
+        # encrypt_algo selects the body cipher (aes256gcm-rsa default,
+        # aes256ctr-*); the key side (RSA-OAEP vs ECIES) follows the PEM
+        store = new_encrypted(store, fmt.encrypt_key.encode(),
+                              algo=fmt.encrypt_algo or "aes256gcm")
     return store
 
 

@@ -31,7 +31,13 @@ def add_parser(sub):
                    choices=["", "none", "cpu", "tpu", "xla", "pallas"],
                    help="fingerprint every written block into the meta "
                         "content index using this hash plane")
-    p.add_argument("--encrypt-rsa-key", default="", help="PEM private key path")
+    p.add_argument("--encrypt-rsa-key", default="",
+                   help="PEM private key path (RSA -> OAEP wrap, EC P-256 "
+                        "-> ECIES wrap)")
+    p.add_argument("--encrypt-algo", default=None,
+                   choices=["aes256gcm-rsa", "aes256ctr-rsa"],
+                   help="object body cipher (reference encrypt.go variants); "
+                        "requires --encrypt-rsa-key")
     p.add_argument("--force", action="store_true", help="overwrite existing format")
     p.set_defaults(func=run)
 
@@ -50,10 +56,13 @@ def run(args) -> int:
         enable_acl=args.enable_acl,
         hash_backend="" if args.hash_backend == "none" else args.hash_backend,
     )
+    if args.encrypt_algo and not args.encrypt_rsa_key:
+        logger.error("--encrypt-algo has no effect without --encrypt-rsa-key")
+        return 1
     if args.encrypt_rsa_key:
         with open(args.encrypt_rsa_key) as f:
             fmt.encrypt_key = f.read()
-        fmt.encrypt_algo = "aes256gcm-rsa"
+        fmt.encrypt_algo = args.encrypt_algo or "aes256gcm-rsa"
 
     from . import storage_for
 

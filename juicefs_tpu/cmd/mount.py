@@ -40,6 +40,15 @@ def add_parser(sub):
     p.add_argument("--metrics", default="",
                    help="host:port for the /metrics endpoint (reference "
                         "exposeMetrics; empty disables, port 0 auto-picks)")
+    p.add_argument("--metrics-push", default="",
+                   help="Pushgateway URL to PUT metrics to every "
+                        "--push-interval seconds (reference metrics push)")
+    p.add_argument("--graphite", default="",
+                   help="host:port to stream Graphite plaintext metrics to")
+    p.add_argument("--push-interval", type=float, default=10.0)
+    p.add_argument("--no-usage-report", action="store_true",
+                   help="disable the anonymous daily usage ping "
+                        "(reference pkg/usage/usage.go)")
     p.add_argument("--takeover", action="store_true",
                    help="seamless upgrade: adopt a running mount's fuse fd, "
                         "open handles, and session (reference passfd.go)")
@@ -130,6 +139,20 @@ def serve(args) -> int:
         metrics_srv = MetricsServer.from_addr(args.metrics)
         logger.info("metrics on http://%s:%d/metrics",
                     metrics_srv.host, metrics_srv.port)
+    pusher = None
+    if getattr(args, "metrics_push", "") or getattr(args, "graphite", ""):
+        from ..metric import MetricsPusher, global_registry
+
+        pusher = MetricsPusher(
+            global_registry(), interval=args.push_interval,
+            pushgateway=args.metrics_push, graphite=args.graphite,
+            job=fmt.name,
+        )
+    usage = None
+    if not getattr(args, "no_usage_report", False):
+        from ..metric.usage import UsageReporter
+
+        usage = UsageReporter(m, fmt)
     srv = Server(vfs, args.mountpoint, fsname=f"juicefs-tpu:{fmt.name}",
                  allow_other=args.allow_other,
                  writeback_cache=not getattr(args, "no_kernel_writeback", False))
@@ -158,6 +181,10 @@ def serve(args) -> int:
             watchdog_stop.set()
         if metrics_srv is not None:
             metrics_srv.stop()
+        if pusher is not None:
+            pusher.stop()
+        if usage is not None:
+            usage.stop()
         if bg is not None:
             bg.stop()
         if srv.handed_over:

@@ -38,8 +38,10 @@ def generate_rsa_key_pem(bits: int = 2048, password: bytes | None = None) -> byt
 class RSAEncryptor:
     """Key encryptor: wraps per-object data keys (reference encrypt.go:125-145)."""
 
-    def __init__(self, pem: bytes, password: bytes | None = None):
-        self._key = serialization.load_pem_private_key(pem, password)
+    def __init__(self, pem: bytes, password: bytes | None = None,
+                 key=None):
+        self._key = key if key is not None else \
+            serialization.load_pem_private_key(pem, password)
         self._pad = padding.OAEP(
             mgf=padding.MGF1(algorithm=hashes.SHA256()),
             algorithm=hashes.SHA256(),
@@ -120,5 +122,127 @@ class _Encrypted(ObjectStorage):
             yield Obj(key=o.key, size=max(o.size - self._e.overhead, 0), mtime=o.mtime, is_dir=o.is_dir)
 
 
-def new_encrypted(store: ObjectStorage, pem: bytes, password: bytes | None = None) -> ObjectStorage:
-    return _Encrypted(store, AESGCMDataEncryptor(RSAEncryptor(pem, password)))
+class ECIESEncryptor:
+    """EC key encryptor (reference encrypt.go:136-145 eciesEncryptor):
+    ephemeral-ECDH over P-256 + HKDF-SHA256 derives a wrapping key, the
+    data key travels AES-GCM-sealed beside the ephemeral public key.
+
+    wrapped = eph_pub(65B uncompressed) || nonce(12) || GCM(data_key)
+    """
+
+    _NONCE = 12
+
+    def __init__(self, pem: bytes, password: bytes | None = None,
+                 key=None):
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        self._key = key if key is not None else \
+            serialization.load_pem_private_key(pem, password)
+        if not isinstance(self._key, ec.EllipticCurvePrivateKey):
+            raise ValueError("ECIES needs an EC private key (P-256 PEM)")
+        self._curve = self._key.curve
+
+    def _derive(self, shared: bytes) -> bytes:
+        from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+        return HKDF(algorithm=hashes.SHA256(), length=32, salt=None,
+                    info=b"jfs-ecies-v1").derive(shared)
+
+    def encrypt(self, data_key: bytes) -> bytes:
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        eph = ec.generate_private_key(self._curve)
+        shared = eph.exchange(ec.ECDH(), self._key.public_key())
+        kek = self._derive(shared)
+        nonce = os.urandom(self._NONCE)
+        sealed = AESGCM(kek).encrypt(nonce, data_key, None)
+        pub = eph.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.UncompressedPoint,
+        )
+        return pub + nonce + sealed
+
+    def decrypt(self, wrapped: bytes) -> bytes:
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        plen = (self._curve.key_size // 8) * 2 + 1  # uncompressed point
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(
+            self._curve, wrapped[:plen]
+        )
+        shared = self._key.exchange(ec.ECDH(), pub)
+        kek = self._derive(shared)
+        nonce = wrapped[plen:plen + self._NONCE]
+        sealed = wrapped[plen + self._NONCE:]
+        return AESGCM(kek).decrypt(nonce, sealed, None)
+
+    @property
+    def wrapped_len(self) -> int:
+        # point + nonce + data_key(32) + GCM tag(16)
+        return (self._curve.key_size // 8) * 2 + 1 + self._NONCE + 48
+
+
+def generate_ec_key_pem(password: bytes | None = None) -> bytes:
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    enc = (
+        serialization.BestAvailableEncryption(password)
+        if password
+        else serialization.NoEncryption()
+    )
+    return key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8, enc
+    )
+
+
+class AESCTRDataEncryptor(AESGCMDataEncryptor):
+    """AES-256-CTR body variant (reference encrypt.go aes256ctr option):
+    no per-object auth tag — pair with the checksummed wrapper when
+    integrity matters; CTR exists for backends that pre-verify content."""
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+        dk = os.urandom(32)
+        nonce = os.urandom(16)  # full CTR counter block
+        enc = Cipher(algorithms.AES(dk), modes.CTR(nonce)).encryptor()
+        body = enc.update(plaintext) + enc.finalize()
+        wrapped = self._ke.encrypt(dk)
+        return struct.pack(">I", len(wrapped)) + wrapped + nonce + body
+
+    def decrypt(self, blob: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+        (klen,) = struct.unpack_from(">I", blob)
+        wrapped = blob[4:4 + klen]
+        nonce = blob[4 + klen:4 + klen + 16]
+        body = blob[4 + klen + 16:]
+        dk = self._ke.decrypt(wrapped)
+        dec = Cipher(algorithms.AES(dk), modes.CTR(nonce)).decryptor()
+        return dec.update(body) + dec.finalize()
+
+    @property
+    def overhead(self) -> int:
+        return 4 + self._ke.wrapped_len + 16  # header + key + counter block
+
+
+def _key_encryptor(pem: bytes, password: bytes | None):
+    """RSA or EC PEM -> the matching key encryptor (reference
+    encrypt.go:66-123 parses both). One parse: the loaded key object is
+    handed to the encryptor (an encrypted PEM's KDF is not cheap)."""
+    key = serialization.load_pem_private_key(pem, password)
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    if isinstance(key, ec.EllipticCurvePrivateKey):
+        return ECIESEncryptor(pem, password, key=key)
+    return RSAEncryptor(pem, password, key=key)
+
+
+def new_encrypted(store: ObjectStorage, pem: bytes,
+                  password: bytes | None = None,
+                  algo: str = "aes256gcm") -> ObjectStorage:
+    """Envelope-encrypt a store. algo: aes256gcm (default) | aes256ctr.
+    The key side (RSA-OAEP vs ECIES) follows the PEM key type."""
+    ke = _key_encryptor(pem, password)
+    cls = AESCTRDataEncryptor if algo.startswith("aes256ctr") else AESGCMDataEncryptor
+    return _Encrypted(store, cls(ke))

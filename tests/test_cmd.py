@@ -429,3 +429,91 @@ def test_fstab_shim_translation():
     assert "--writeback" in out
     assert "-d" in out  # fstab mounts daemonize
     assert "--defaults" not in out and "--_netdev" not in out
+
+
+def test_metrics_pusher_graphite_and_gateway():
+    """Push-based metrics export (reference pkg/metric/metrics.go:67):
+    Graphite plaintext over TCP and Pushgateway PUT, against local
+    listeners; failures only count, never raise."""
+    import http.server
+    import socket
+    import threading
+
+    from juicefs_tpu.metric import MetricsPusher, Registry
+
+    reg = Registry()
+    reg.gauge("juicefs_test_gauge", "t").set(42)
+    reg.counter("juicefs_test_counter", "t").inc(7)
+
+    # graphite sink
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    gport = srv.getsockname()[1]
+    got = {}
+
+    def accept():
+        conn, _ = srv.accept()
+        buf = b""
+        while True:
+            d = conn.recv(65536)
+            if not d:
+                break
+            buf += d
+        got["graphite"] = buf.decode()
+        conn.close()
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+
+    # pushgateway sink
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            got["gateway"] = self.rfile.read(n).decode()
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    hs = http.server.HTTPServer(("127.0.0.1", 0), H)
+    hport = hs.server_port
+    threading.Thread(target=hs.handle_request, daemon=True).start()
+
+    p = MetricsPusher(reg, interval=3600,
+                      pushgateway=f"http://127.0.0.1:{hport}",
+                      graphite=f"127.0.0.1:{gport}", job="testvol")
+    p.push_once()
+    t.join(5)
+    p.stop()
+    hs.server_close()
+    srv.close()
+    assert "juicefs.juicefs_test_gauge 42" in got["graphite"]
+    assert "juicefs_test_counter 7" in got["gateway"]
+    assert p.errors == 0 and p.pushes >= 1
+
+    # failure is silent: dead endpoints only bump the error counter
+    p2 = MetricsPusher(reg, interval=3600, graphite="127.0.0.1:1")
+    p2.push_once()
+    p2.stop()
+    assert p2.errors == 1
+
+
+def test_usage_reporter_fail_silent():
+    """The anonymous ping must never raise offline; payload carries the
+    anonymous fields only (reference usage.go:70)."""
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.metric.usage import UsageReporter
+
+    m = new_client("mem://")
+    fmt = Format(name="u")
+    m.init(fmt, force=True)
+    m.load()
+    r = UsageReporter(m, fmt, url="http://127.0.0.1:1/nope", interval=3600)
+    r.report_once()
+    r.stop()
+    assert r.errors >= 1 and r.reports == 0
+    pl = r.payload()
+    assert set(pl) == {"uuid", "version", "usedSpace", "usedInodes",
+                       "metaEngine", "storage"}
