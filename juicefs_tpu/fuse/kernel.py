@@ -88,6 +88,8 @@ FUSE_FLOCK_LOCKS = 1 << 10
 FUSE_BIG_WRITES = 1 << 5
 FUSE_DONT_MASK = 1 << 6
 FUSE_AUTO_INVAL_DATA = 1 << 12
+FUSE_DO_READDIRPLUS = 1 << 13
+FUSE_READDIRPLUS_AUTO = 1 << 14
 FUSE_ASYNC_DIO = 1 << 15
 FUSE_WRITEBACK_CACHE = 1 << 16
 FUSE_PARALLEL_DIROPS = 1 << 18
@@ -162,3 +164,11 @@ def pack_dirent(ino: int, off: int, name: bytes, dtype: int) -> bytes:
     ent = DIRENT_HEADER.pack(ino, off, len(name), dtype) + name
     pad = (-len(ent)) % 8
     return ent + b"\0" * pad
+
+
+def pack_direntplus(entry_out: bytes, ino: int, off: int, name: bytes,
+                    dtype: int) -> bytes:
+    """One fuse_direntplus: fuse_entry_out (128B) + aligned fuse_dirent —
+    the kernel primes its dcache/attr cache from the inline entry, so an
+    `ls -l` costs ONE request instead of one LOOKUP+GETATTR per name."""
+    return entry_out + pack_dirent(ino, off, name, dtype)

@@ -114,6 +114,7 @@ class Server:
             k.FLUSH: self._flush,
             k.OPENDIR: self._opendir,
             k.READDIR: self._readdir,
+            k.READDIRPLUS: self._readdirplus,
             k.RELEASEDIR: self._releasedir,
             k.FSYNCDIR: lambda c, h, b: b"",
             k.ACCESS: self._access,
@@ -399,6 +400,12 @@ class Server:
             # conflict at all (reference go-fuse enables both)
             | k.FUSE_POSIX_LOCKS
             | k.FUSE_FLOCK_LOCKS
+            # READDIRPLUS: entries arrive with inline attrs, killing the
+            # per-name LOOKUP storm after every listing (reference go-fuse
+            # negotiates it too); AUTO lets the kernel choose plain
+            # READDIR for seekdir-style access
+            | k.FUSE_DO_READDIRPLUS
+            | k.FUSE_READDIRPLUS_AUTO
         )
         if getattr(self.vfs, "_acl_enabled", lambda: False)():
             # Kernel-managed ACLs (reference go-fuse EnableAcl): the kernel
@@ -605,6 +612,27 @@ class Server:
         for i, e in enumerate(entries):
             dtype = (type_to_stat_mode(e.attr.typ, 0) >> 12) if e.attr else 0
             ent = k.pack_dirent(e.inode, offset + i + 1, e.name, dtype)
+            if len(out) + len(ent) > size:
+                break
+            out += ent
+        return bytes(out)
+
+    def _readdirplus(self, ctx, hdr, body):
+        fh, offset, size, _rf, _lo, _fl, _ = k.READ_IN.unpack_from(body)
+        st, entries = self.vfs.readdir(ctx, hdr[1], fh, offset, want_attr=True)
+        if st:
+            return st
+        out = bytearray()
+        zero_entry = b"\0" * (k.ENTRY_OUT.size + k.ATTR.size)
+        for i, e in enumerate(entries):
+            dtype = (type_to_stat_mode(e.attr.typ, 0) >> 12) if e.attr else 0
+            if e.name in (b".", b"..") or e.attr is None or not e.attr.full:
+                # protocol: nodeid 0 = no dcache entry primed, no lookup
+                # count taken ("." / ".." / attr-less entries)
+                eo = zero_entry
+            else:
+                eo = self._entry_out(e.inode, e.attr)
+            ent = k.pack_direntplus(eo, e.inode, offset + i + 1, e.name, dtype)
             if len(out) + len(ent) > size:
                 break
             out += ent

@@ -85,6 +85,14 @@ class MetaCache:
         # (ino, want_attr) -> list[Entry]: full readdir snapshots
         # (reference pkg/vfs readdir cache / pkg/fs dirStream cache)
         self.dirs = TTLCache(dir_ttl, maxsize=10_000)
+        # reverse index: member ino -> dir-snapshot keys holding its attr.
+        # Attr-ful snapshots must honor read-your-own-writes: a hardlink/
+        # chmod/write on a member invalidates every snapshot that embeds
+        # its (now stale) attr — READDIRPLUS primes the kernel attr cache
+        # straight from these snapshots, so staleness here would surface
+        # in stat() (caught by the POSIX oracle harness).
+        self._dir_members: dict[int, set] = {}
+        self._members_lock = threading.Lock()
 
     # -- reads -------------------------------------------------------------
     def get_attr(self, ino: int):
@@ -102,6 +110,23 @@ class MetaCache:
     # -- invalidation (local mutations) ------------------------------------
     def invalidate_attr(self, ino: int) -> None:
         self.attrs.invalidate(ino)
+        self._drop_member_snapshots(ino)
+
+    def attr_mutated(self, ino: int, attr) -> None:
+        """A LOCAL mutation produced this fresh attr: cache it, but drop
+        every attr-bearing dir snapshot embedding the old one
+        (read-your-own-writes for READDIRPLUS/SDK listings). put_attr
+        alone is for read-path refreshes, where snapshot staleness is
+        within the TTL contract."""
+        self.attrs.put(ino, attr)
+        self._drop_member_snapshots(ino)
+
+    def _drop_member_snapshots(self, ino: int) -> None:
+        with self._members_lock:
+            keys = self._dir_members.pop(ino, None)
+        if keys:
+            for key in keys:
+                self.dirs.invalidate(key)
 
     def invalidate_entry(self, parent: int, name: bytes) -> int | None:
         """Drop one dentry; returns the ino it pointed to if cached (so the
@@ -116,7 +141,28 @@ class MetaCache:
         return self.dirs.get((ino, want_attr))
 
     def put_dir(self, ino: int, want_attr: bool, entries) -> None:
-        self.dirs.put((ino, want_attr), entries)
+        key = (ino, want_attr)
+        self.dirs.put(key, entries)
+        if want_attr and self.dirs.enabled:
+            reset = False
+            with self._members_lock:
+                if len(self._dir_members) > 100_000:
+                    # lazily-expired snapshots leave stale rows behind;
+                    # resetting must OVER-invalidate: dropping the index
+                    # while keeping the snapshots would disconnect them
+                    # from mutation invalidation permanently
+                    self._dir_members.clear()
+                    reset = True
+                for e in entries:
+                    if e.name in (b".", b".."):
+                        # never registered: the kernel gets zeroed attrs
+                        # for these, and indexing them would evict every
+                        # child snapshot on any parent namespace change
+                        continue
+                    self._dir_members.setdefault(e.inode, set()).add(key)
+            if reset:
+                self.dirs.clear()
+                self.dirs.put(key, entries)
 
     def invalidate_dir(self, ino: int) -> None:
         self.dirs.invalidate((ino, False))

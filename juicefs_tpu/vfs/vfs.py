@@ -232,7 +232,7 @@ class VFS:
                 return st, Attr()
         st, out = self.meta.setattr(ctx, ino, flags, attr)
         if st == 0:
-            self.cache.put_attr(ino, out)
+            self.cache.attr_mutated(ino, out)
             if flags & SET_ATTR_SIZE:
                 self.writer.truncate(ino, out.length)
         return st, out
@@ -268,7 +268,9 @@ class VFS:
         self.cache.invalidate_attr(parent)
         self.cache.invalidate_dir(parent)
         self.cache.put_entry(parent, name, ino)
-        self.cache.put_attr(ino, attr)
+        # mutation-grade: a hardlink target's nlink changed in EVERY
+        # directory snapshot that embeds it, not just the new parent's
+        self.cache.attr_mutated(ino, attr)
 
     def _entry_removed(self, parent: int, name: bytes) -> None:
         ino = self.cache.invalidate_entry(parent, name)
@@ -522,7 +524,7 @@ class VFS:
             return st, Attr()
         st, attr = self.meta.truncate(ctx, ino, length)
         if st == 0:
-            self.cache.put_attr(ino, attr)
+            self.cache.attr_mutated(ino, attr)
             self.writer.truncate(ino, length)
         return st, attr
 

@@ -572,3 +572,26 @@ def test_cross_mount_lock_conflict_and_wake(tmp_path):
             except Exception:
                 pass
         rsrv.stop()
+
+
+def test_readdirplus_snapshot_coherence(mnt):
+    """READDIRPLUS primes the kernel attr cache from the VFS dir
+    snapshot; a local mutation (chmod/hardlink/truncate) must invalidate
+    every snapshot embedding the old attr, or stat() serves stale
+    metadata (the POSIX oracle caught the nlink variant of this)."""
+    d = os.path.join(mnt, "plus")
+    os.mkdir(d)
+    for i in range(5):
+        with open(os.path.join(d, f"f{i}"), "wb") as f:
+            f.write(b"x" * 10)
+    # prime: list with attrs (READDIRPLUS path)
+    for ent in os.scandir(d):
+        ent.stat()
+    os.chmod(os.path.join(d, "f0"), 0o600)
+    os.truncate(os.path.join(d, "f1"), 3)
+    os.link(os.path.join(d, "f2"), os.path.join(mnt, "hard"))
+    # immediate re-list + stat must see every mutation (read-your-writes)
+    seen = {e.name: e.stat() for e in os.scandir(d)}
+    assert seen["f0"].st_mode & 0o777 == 0o600
+    assert seen["f1"].st_size == 3
+    assert seen["f2"].st_nlink == 2
