@@ -714,15 +714,32 @@ class Server:
             return k.LSEEK_OUT.pack(attr.length)
         return _errno.EINVAL
 
+    @staticmethod
+    def _lk_end(end: int) -> int:
+        """Kernel->meta lock range conversion. fuse_file_lock.end is
+        INCLUSIVE and signed (-1 / OFFSET_MAX = to-EOF, arriving as huge
+        unsigned values through the wire struct); the meta layer uses
+        EXCLUSIVE ends. So: to-EOF maps to int64-max (typed meta engines
+        reject anything larger — caught by the POSIX oracle over the sql
+        engine), and a finite end becomes end+1 — previously a 1-byte
+        lock on byte 0 (end=0) was misread as whole-file."""
+        if end >= (1 << 63) - 1:
+            return (1 << 63) - 1
+        return end + 1
+
     def _getlk(self, ctx, hdr, body):
         fh, owner, start, end, ltype, pid, _fl, _ = k.LK_IN.unpack_from(body)
+        end = self._lk_end(end)
         if not hasattr(self.vfs.meta, "getlk"):
             return k.LK_OUT.pack(0, 0, 2, 0)  # report unlocked (F_UNLCK)
         st, ltype, lstart, lend, lpid = self.vfs.meta.getlk(
-            ctx, hdr[1], owner, ltype, start, end or (1 << 63) - 1
+            ctx, hdr[1], owner, ltype, start, end
         )
         if st:
             return st
+        # meta end is exclusive; the kernel's is inclusive
+        if 0 < lend < (1 << 63) - 1:
+            lend -= 1
         return k.LK_OUT.pack(lstart, lend, ltype, lpid)
 
     def _setlk(self, ctx, hdr, body, wait: bool = False, abort=None):
@@ -744,7 +761,7 @@ class Server:
                 lambda: self.vfs.meta.flock(ctx, hdr[1], owner, kind),
                 wait, abort,
             )
-        end = end or (1 << 63) - 1
+        end = self._lk_end(end)
         return self._lock_retry(
             hdr[1],
             lambda: self.vfs.meta.setlk(ctx, hdr[1], owner, ltype, start, end, pid),

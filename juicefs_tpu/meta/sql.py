@@ -1900,7 +1900,16 @@ class SQLMeta(BaseMeta):
         return self._txn(fn)
 
     # ---- locks (reference sql_lock.go over flock/plock rows) -------------
+    @staticmethod
+    def _s64(v: int) -> int:
+        """Lock owners are kernel-generated u64 cookies, frequently >=
+        2^63; sqlite INTEGER is signed 64-bit, so store the two's
+        complement (caught by the POSIX oracle over a real mount)."""
+        return v - (1 << 64) if v >= (1 << 63) else v
+
     def flock(self, ctx, ino: int, owner: int, ltype: str) -> int:
+        sowner = self._s64(owner)
+
         def fn(cur):
             rows = cur.execute(
                 "SELECT sid, owner, ltype FROM flock WHERE inode=?", (ino,)
@@ -1908,24 +1917,24 @@ class SQLMeta(BaseMeta):
             if ltype == "U":
                 cur.execute(
                     "DELETE FROM flock WHERE inode=? AND sid=? AND owner=?",
-                    (ino, self.sid, owner),
+                    (ino, self.sid, sowner),
                 )
             elif ltype == "R":
-                if any(t == "W" and (s, o) != (self.sid, owner)
+                if any(t == "W" and (s, o) != (self.sid, sowner)
                        for s, o, t in rows):
                     return errno.EAGAIN
                 cur.execute(
                     "INSERT OR REPLACE INTO flock (inode,sid,owner,ltype) "
                     "VALUES (?,?,?,'R')",
-                    (ino, self.sid, owner),
+                    (ino, self.sid, sowner),
                 )
             elif ltype == "W":
-                if any((s, o) != (self.sid, owner) for s, o, _t in rows):
+                if any((s, o) != (self.sid, sowner) for s, o, _t in rows):
                     return errno.EAGAIN
                 cur.execute(
                     "INSERT OR REPLACE INTO flock (inode,sid,owner,ltype) "
                     "VALUES (?,?,?,'W')",
-                    (ino, self.sid, owner),
+                    (ino, self.sid, sowner),
                 )
             else:
                 return errno.EINVAL
@@ -1937,6 +1946,8 @@ class SQLMeta(BaseMeta):
         return st
 
     def setlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int, pid: int = 0) -> int:
+        owner = self._s64(owner)
+
         def fn(cur):
             if ltype == self.F_UNLCK:
                 mine = cur.execute(
@@ -1986,6 +1997,8 @@ class SQLMeta(BaseMeta):
         return st
 
     def getlk(self, ctx, ino: int, owner: int, ltype: int, start: int, end: int) -> tuple[int, int, int, int, int]:
+        owner = self._s64(owner)
+
         def fn(cur):
             row = cur.execute(
                 "SELECT ltype, start, end, pid FROM plock "
@@ -2095,15 +2108,20 @@ class SQLMeta(BaseMeta):
             for ino, sid, owner, lt in cur.execute(
                 "SELECT inode, sid, owner, ltype FROM flock"
             ):
-                flocks.setdefault(ino, {})[f"{sid}/{owner:x}"] = lt
+                # dump format is canonical-unsigned (the KV engine keys by
+                # the raw u64 cookie); convert back from signed storage
+                flocks.setdefault(ino, {})[
+                    f"{sid}/{owner & ((1 << 64) - 1):x}"] = lt
             for ino, table in flocks.items():
                 recs.append((b"F" + ino.to_bytes(8, "big"),
                              json.dumps(table).encode()))
             plocks: dict[int, list] = {}
+            u64 = (1 << 64) - 1
             for ino, sid, owner, lt, ls, le, pid in cur.execute(
                 "SELECT inode, sid, owner, ltype, start, end, pid FROM plock"
             ):
-                plocks.setdefault(ino, []).append([sid, owner, lt, ls, le, pid])
+                plocks.setdefault(ino, []).append(
+                    [sid, owner & u64, lt, ls & u64, le & u64, pid])
             for ino, lst in plocks.items():
                 recs.append((b"L" + ino.to_bytes(8, "big"),
                              json.dumps(lst).encode()))
@@ -2206,13 +2224,14 @@ class SQLMeta(BaseMeta):
                         sid_s, owner_s = ow.split("/")
                         cur.execute(
                             "INSERT OR REPLACE INTO flock VALUES (?,?,?,?)",
-                            (ino, int(sid_s), int(owner_s, 16), lt))
+                            (ino, int(sid_s), self._s64(int(owner_s, 16)), lt))
                 elif k.startswith(b"L"):
                     ino = int.from_bytes(k[1:9], "big")
                     for sid, owner, lt, ls, le, pid in json.loads(v):
                         cur.execute(
                             "INSERT INTO plock VALUES (?,?,?,?,?,?,?)",
-                            (ino, sid, owner, lt, ls, le, pid))
+                            (ino, sid, self._s64(owner), lt,
+                             self._s64(ls), self._s64(le), pid))
                 elif k.startswith(b"SE"):
                     cur.execute(
                         "INSERT OR REPLACE INTO session2 (sid, info, heartbeat) "

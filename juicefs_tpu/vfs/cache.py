@@ -93,6 +93,12 @@ class MetaCache:
         # in stat() (caught by the POSIX oracle harness).
         self._dir_members: dict[int, set] = {}
         self._members_lock = threading.Lock()
+        # publication guard: a snapshot whose attrs were read BEFORE a
+        # concurrent mutation must not be published AFTER it (the mutation
+        # could not invalidate what was not yet registered). Callers take
+        # dir_read_begin() before the meta read and hand the token to
+        # put_dir, which discards the publish if any attr mutated since.
+        self._mutation_gen = 0
 
     # -- reads -------------------------------------------------------------
     def get_attr(self, ino: int):
@@ -123,10 +129,16 @@ class MetaCache:
 
     def _drop_member_snapshots(self, ino: int) -> None:
         with self._members_lock:
+            self._mutation_gen += 1
             keys = self._dir_members.pop(ino, None)
         if keys:
             for key in keys:
                 self.dirs.invalidate(key)
+
+    def dir_read_begin(self) -> int:
+        """Token for put_dir: take BEFORE reading the listing from meta."""
+        with self._members_lock:
+            return self._mutation_gen
 
     def invalidate_entry(self, parent: int, name: bytes) -> int | None:
         """Drop one dentry; returns the ino it pointed to if cached (so the
@@ -140,29 +152,40 @@ class MetaCache:
     def get_dir(self, ino: int, want_attr: bool):
         return self.dirs.get((ino, want_attr))
 
-    def put_dir(self, ino: int, want_attr: bool, entries) -> None:
+    def put_dir(self, ino: int, want_attr: bool, entries,
+                gen: int | None = None) -> None:
         key = (ino, want_attr)
-        self.dirs.put(key, entries)
-        if want_attr and self.dirs.enabled:
-            reset = False
-            with self._members_lock:
-                if len(self._dir_members) > 100_000:
-                    # lazily-expired snapshots leave stale rows behind;
-                    # resetting must OVER-invalidate: dropping the index
-                    # while keeping the snapshots would disconnect them
-                    # from mutation invalidation permanently
-                    self._dir_members.clear()
-                    reset = True
-                for e in entries:
-                    if e.name in (b".", b".."):
-                        # never registered: the kernel gets zeroed attrs
-                        # for these, and indexing them would evict every
-                        # child snapshot on any parent namespace change
-                        continue
-                    self._dir_members.setdefault(e.inode, set()).add(key)
+        if not (want_attr and self.dirs.enabled):
+            self.dirs.put(key, entries)
+            return
+        # gen-check, publish, and member registration are ONE critical
+        # section: a mutation between any two of them would leave a stale
+        # snapshot that invalidation can never find (lock order here is
+        # members_lock -> dirs lock, same as _drop_member_snapshots)
+        reset = False
+        with self._members_lock:
+            if gen is not None and self._mutation_gen != gen:
+                # an attr mutated between the meta read and here: the
+                # snapshot may embed the pre-mutation attr and the
+                # mutation could not invalidate it — don't publish
+                return
+            if len(self._dir_members) > 100_000:
+                # lazily-expired snapshots leave stale rows behind;
+                # resetting must OVER-invalidate: dropping the index
+                # while keeping the snapshots would disconnect them
+                # from mutation invalidation permanently
+                self._dir_members.clear()
+                reset = True
             if reset:
                 self.dirs.clear()
-                self.dirs.put(key, entries)
+            self.dirs.put(key, entries)
+            for e in entries:
+                if e.name in (b".", b".."):
+                    # never registered: the kernel gets zeroed attrs
+                    # for these, and indexing them would evict every
+                    # child snapshot on any parent namespace change
+                    continue
+                self._dir_members.setdefault(e.inode, set()).add(key)
 
     def invalidate_dir(self, ino: int) -> None:
         self.dirs.invalidate((ino, False))

@@ -372,10 +372,11 @@ class VFS:
                 if st != 0:
                     return st, []
             else:
+                gen = self.cache.dir_read_begin()
                 st, entries = self.meta.readdir(ctx, ino, want_attr)
                 if st != 0:
                     return st, []
-                self.cache.put_dir(ino, want_attr, entries)
+                self.cache.put_dir(ino, want_attr, entries, gen=gen)
             h.children = entries
         return 0, h.children[offset:]
 

@@ -33,7 +33,7 @@ import contextlib
 
 @contextlib.contextmanager
 def fuse_mount(tmp_path, block_size=1 << 20, cache_dirs=("memory",),
-               **format_kw):
+               meta_url="mem://", **format_kw):
     """Shared FUSE loop-mount lifecycle (used by test_fuse / test_fsx /
     test_posix_oracle): build the full stack on mem:// meta + mem://
     objects, mount, wait for the kernel INIT handshake, yield the
@@ -55,7 +55,7 @@ def fuse_mount(tmp_path, block_size=1 << 20, cache_dirs=("memory",),
 
     format_kw.setdefault("name", "fusetest")
     format_kw.setdefault("storage", "mem")
-    m = new_client("mem://")
+    m = new_client(meta_url)
     m.init(Format(block_size=block_size >> 10, **format_kw), force=False)
     m.load()
     m.new_session()

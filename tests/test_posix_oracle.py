@@ -365,11 +365,17 @@ class OpGen:
         raise AssertionError(kind)
 
 
-@pytest.fixture
-def mounted(tmp_path):
+@pytest.fixture(params=["mem", "sql"])
+def mounted(tmp_path, request):
+    """Run the oracle over BOTH engine families: the KV engine (mem://)
+    and the round-4 relational engine (sql://) — kernel-level semantic
+    validation for each independent implementation."""
     from conftest import fuse_mount
 
-    with fuse_mount(tmp_path, name="oracle", trash_days=0) as mp:
+    meta_url = ("mem://" if request.param == "mem"
+                else f"sql://{tmp_path}/oracle-rel.db")
+    with fuse_mount(tmp_path, name="oracle", trash_days=0,
+                    meta_url=meta_url) as mp:
         yield mp
 
 
