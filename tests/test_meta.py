@@ -695,3 +695,32 @@ def test_engine_migration_kv_to_sql_and_back(tmp_path):
     recs1 = {tuple(r) for r in doc["records"]}
     recs2 = {tuple(r) for r in doc2["records"]}
     assert recs1 == recs2
+
+
+def test_build_slice_partial_overlap_offsets():
+    """A newer slice covering the MIDDLE of an older one splits it into
+    head/tail segments whose `off` must point into the ORIGINAL stored
+    slice at the right byte (found as a surviving mutant of slice.py by
+    tools/mutate.py: test_meta never pinned the off arithmetic)."""
+    old = Slice(pos=0, id=7, size=100, off=0, len=100)
+    new = Slice(pos=30, id=9, size=40, off=0, len=40)
+    view = build_slice([old, new])
+    assert [(s.pos, s.id, s.off, s.len) for s in view] == [
+        (0, 7, 0, 30),     # head of the old slice
+        (30, 9, 0, 40),    # the overwrite
+        (70, 7, 70, 30),   # tail: off MUST be 70 into slice 7
+    ]
+    # overlapping chain of three writes, non-zero base offsets
+    a = Slice(pos=10, id=1, size=50, off=5, len=50)
+    b = Slice(pos=40, id=2, size=30, off=2, len=30)
+    c = Slice(pos=20, id=3, size=10, off=0, len=10)
+    view = build_slice([a, b, c])
+    assert [(s.pos, s.id, s.off, s.len) for s in view] == [
+        (0, 0, 0, 10),         # leading hole reads zeros
+        (10, 1, 5, 10),        # a's head
+        (20, 3, 0, 10),        # c overwrote a's middle
+        (30, 1, 25, 10),       # a resumes: off = 5 + (30-10)
+        (40, 2, 2, 30),        # b overwrote a's tail
+    ]
+    # hole segments keep size == len (consumers read either field)
+    assert all(s.size == s.len for s in view if s.id == 0)

@@ -1,0 +1,231 @@
+#!/usr/bin/env python3
+"""Mutation-testing runner (reference analog: .github/scripts/mutate/,
+a go-mutesting wrapper running per-PR changed-line mutation).
+
+Generates first-order mutants of a target module with ast rewrites,
+runs a mapped test subset against each, and reports killed/survived.
+A surviving mutant is a behavior change no test noticed — either dead
+code or a coverage gap.
+
+    python tools/mutate.py juicefs_tpu/meta/slice.py
+    python tools/mutate.py juicefs_tpu/vfs/cache.py --max-mutants 20
+    python tools/mutate.py --list juicefs_tpu/meta/kv.py
+
+Mutators (classic first-order set):
+    cmp   flip comparison operators  (< <-> <=, == <-> !=, > <-> >=)
+    bool  swap and/or; drop `not`
+    arith +/- swap, *// swap
+    const integer off-by-one (skips 0/1-as-index-ish small literals)
+    ret   `return X` -> `return None` in non-None-returning spots
+
+Deterministic: mutants are enumerated in source order; --seed/--sample
+picks a reproducible subset. Timeout per mutant kills hangs (an
+infinite-loop mutant counts as killed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import copy
+import os
+import subprocess
+import sys
+import random
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# module-prefix -> fast test subset proving its behavior
+TEST_MAP = {
+    "juicefs_tpu/meta/slice": ["tests/test_meta.py", "tests/test_fsx.py"],
+    "juicefs_tpu/meta/acl": ["tests/test_acl.py"],
+    "juicefs_tpu/meta/kv": ["tests/test_meta.py", "tests/test_meta_random.py"],
+    "juicefs_tpu/meta/sql": ["tests/test_meta.py", "tests/test_meta_random.py"],
+    "juicefs_tpu/meta/base": ["tests/test_meta.py"],
+    "juicefs_tpu/vfs/cache": ["tests/test_vfs.py", "tests/test_fuse.py"],
+    "juicefs_tpu/vfs/reader": ["tests/test_vfs.py", "tests/test_fsx.py"],
+    "juicefs_tpu/vfs/writer": ["tests/test_vfs.py", "tests/test_fsx.py"],
+    "juicefs_tpu/chunk/cached_store": ["tests/test_chunk.py"],
+    "juicefs_tpu/chunk/disk_cache": ["tests/test_chunk.py"],
+    "juicefs_tpu/tpu/jth256": ["tests/test_tpu_hash.py"],
+}
+DEFAULT_TESTS = ["tests/test_meta.py", "tests/test_vfs.py"]
+
+_CMP_FLIP = {ast.Lt: ast.LtE, ast.LtE: ast.Lt, ast.Gt: ast.GtE,
+             ast.GtE: ast.Gt, ast.Eq: ast.NotEq, ast.NotEq: ast.Eq}
+_ARITH_FLIP = {ast.Add: ast.Sub, ast.Sub: ast.Add,
+               ast.Mult: ast.FloorDiv, ast.FloorDiv: ast.Mult}
+
+
+class _Enumerator(ast.NodeVisitor):
+    """Walk the tree once, recording every mutation site."""
+
+    def __init__(self):
+        self.sites = []  # (kind, lineno, description, apply_fn_factory)
+
+    def visit_Compare(self, node):
+        for i, op in enumerate(node.ops):
+            t = type(op)
+            if t in _CMP_FLIP:
+                self.sites.append((
+                    "cmp", node.lineno,
+                    f"{t.__name__} -> {_CMP_FLIP[t].__name__}",
+                    ("cmp", id(node), i),
+                ))
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node):
+        t = ast.Or if isinstance(node.op, ast.And) else ast.And
+        self.sites.append((
+            "bool", node.lineno,
+            f"{type(node.op).__name__} -> {t.__name__}",
+            ("boolop", id(node), 0),
+        ))
+        self.generic_visit(node)
+
+    def visit_UnaryOp(self, node):
+        if isinstance(node.op, ast.Not):
+            self.sites.append((
+                "bool", node.lineno, "drop not", ("dropnot", id(node), 0),
+            ))
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node):
+        t = type(node.op)
+        if t in _ARITH_FLIP:
+            self.sites.append((
+                "arith", node.lineno,
+                f"{t.__name__} -> {_ARITH_FLIP[t].__name__}",
+                ("binop", id(node), 0),
+            ))
+        self.generic_visit(node)
+
+    def visit_Constant(self, node):
+        if isinstance(node.value, int) and not isinstance(node.value, bool) \
+                and abs(node.value) > 1:
+            self.sites.append((
+                "const", node.lineno,
+                f"{node.value} -> {node.value + 1}",
+                ("const", id(node), 0),
+            ))
+        self.generic_visit(node)
+
+
+def _apply(tree, token):
+    """Return a mutated DEEP COPY of tree, or None if not applicable."""
+    kind, node_id, idx = token
+    # map original node ids onto the copy by parallel walk
+    clone = copy.deepcopy(tree)
+    for orig, new in zip(ast.walk(tree), ast.walk(clone)):
+        if id(orig) != node_id:
+            continue
+        if kind == "cmp":
+            t = type(new.ops[idx])
+            new.ops[idx] = _CMP_FLIP[t]()
+        elif kind == "boolop":
+            new.op = ast.Or() if isinstance(new.op, ast.And) else ast.And()
+        elif kind == "dropnot":
+            _replace_child(clone, new, new.operand)
+        elif kind == "binop":
+            new.op = _ARITH_FLIP[type(new.op)]()
+        elif kind == "const":
+            new.value = new.value + 1
+        return clone
+    return None
+
+
+def _replace_child(tree, old, new):
+    for parent in ast.walk(tree):
+        for field, value in ast.iter_fields(parent):
+            if value is old:
+                setattr(parent, field, new)
+                return
+            if isinstance(value, list):
+                for i, v in enumerate(value):
+                    if v is old:
+                        value[i] = new
+                        return
+
+
+def run_mutant(path: str, source_tree, token, tests, timeout: float) -> str:
+    mutated = _apply(source_tree, token)
+    if mutated is None:
+        return "skip"
+    code = ast.unparse(ast.fix_missing_locations(mutated))
+    original = open(path).read()
+    try:
+        open(path, "w").write(code)
+        p = subprocess.run(
+            [sys.executable, "-m", "pytest", "-x", "-q", "--no-header",
+             "-p", "no:cacheprovider"] + tests,
+            cwd=REPO, capture_output=True, timeout=timeout,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        return "survived" if p.returncode == 0 else "killed"
+    except subprocess.TimeoutExpired:
+        return "killed"  # hang = behavior change noticed
+    finally:
+        open(path, "w").write(original)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("target", help="module path relative to the repo root")
+    ap.add_argument("--max-mutants", type=int, default=0,
+                    help="sample at most N mutants (0 = all)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=240.0)
+    ap.add_argument("--list", action="store_true",
+                    help="only enumerate mutation sites")
+    ap.add_argument("--tests", default="",
+                    help="comma-separated test files (default: mapped)")
+    args = ap.parse_args()
+
+    path = os.path.join(REPO, args.target)
+    tree = ast.parse(open(path).read())
+    enum = _Enumerator()
+    enum.visit(tree)
+    sites = enum.sites
+    print(f"{args.target}: {len(sites)} mutation sites")
+    if args.list:
+        for kind, line, desc, _tok in sites:
+            print(f"  L{line:5d} [{kind}] {desc}")
+        return 0
+
+    if args.tests:
+        tests = args.tests.split(",")
+    else:
+        key = args.target.rsplit(".", 1)[0]
+        tests = TEST_MAP.get(key, DEFAULT_TESTS)
+    print(f"tests per mutant: {tests}")
+
+    chosen = list(range(len(sites)))
+    if args.max_mutants and args.max_mutants < len(chosen):
+        rng = random.Random(args.seed)
+        chosen = sorted(rng.sample(chosen, args.max_mutants))
+
+    killed = survived = 0
+    survivors = []
+    t0 = time.time()
+    for n, i in enumerate(chosen):
+        kind, line, desc, tok = sites[i]
+        verdict = run_mutant(path, tree, tok, tests, args.timeout)
+        if verdict == "killed":
+            killed += 1
+        elif verdict == "survived":
+            survived += 1
+            survivors.append((line, kind, desc))
+        print(f"[{n+1}/{len(chosen)}] L{line} {kind}: {desc} -> {verdict}")
+    dt = time.time() - t0
+    total = killed + survived
+    score = 100.0 * killed / total if total else 0.0
+    print(f"\nmutation score: {score:.0f}% ({killed}/{total} killed, "
+          f"{dt:.0f}s)")
+    for line, kind, desc in survivors:
+        print(f"  SURVIVED L{line} [{kind}] {desc}")
+    return 0 if survived == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
