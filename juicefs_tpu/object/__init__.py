@@ -50,6 +50,12 @@ def _s3_factory(addr: str) -> ObjectStorage:
     return S3Storage(addr)
 
 
+def _azure_factory(addr: str) -> ObjectStorage:
+    from .azure import AzureBlobStorage
+
+    return AzureBlobStorage(addr)
+
+
 def _webdav_factory(addr: str) -> ObjectStorage:
     from .webdav import WebDAVStorage
 
@@ -73,6 +79,8 @@ register("mem", lambda addr: MemStorage(addr))
 register("s3", _s3_factory)
 register("minio", _s3_factory)
 register("webdav", _webdav_factory)
+register("azure", _azure_factory)
+register("wasb", _azure_factory)
 register("sqlite3", _sqlite_factory)
 register("sqlite", _sqlite_factory)
 register("redis", _redis_obj_factory)

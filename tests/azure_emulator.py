@@ -1,0 +1,166 @@
+"""Minimal Azure Blob service emulator for hermetic driver tests
+(the role Azurite plays for the reference's azure driver; same pattern
+as testing the s3 driver against the in-repo S3 gateway). Implements
+the exact subset object/azure.py speaks — container create, Put/Get/
+Delete Blob, properties, flat List Blobs with marker pagination,
+Copy Blob, Put Block / Put Block List — with real SharedKey
+verification, so the driver's signing is tested, not mocked."""
+
+from __future__ import annotations
+
+import base64
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from juicefs_tpu.object.azure import SharedKey
+
+_EPOCH_FMT = "%a, %d %b %Y %H:%M:%S GMT"
+
+
+class AzureEmulator:
+    def __init__(self, account: str = "devaccount",
+                 key_b64: str = base64.b64encode(b"secret-key-32-bytes!").decode()):
+        self.account = account
+        self.key_b64 = key_b64
+        self.signer = SharedKey(account, key_b64)
+        self.containers: dict[str, dict[str, bytes]] = {}
+        self.blocks: dict[tuple[str, str], dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+        self._srv = None
+
+    def start(self) -> int:
+        emu = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _q(self):
+                u = urllib.parse.urlsplit(self.path)
+                return u.path, dict(urllib.parse.parse_qsl(u.query))
+
+            def _reply(self, code, body=b"", headers=None):
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _auth_ok(self, path, query):
+                # verify against the ENCODED request path — the driver
+                # signs the URI as sent (percent-encoded), matching real
+                # Azure's canonicalized-resource rule
+                h = {k: v for k, v in self.headers.items()}
+                return emu.signer.verify(
+                    self.command, path, query, h,
+                    self.headers.get("Authorization", ""),
+                )
+
+            def _handle(self, body: bytes):
+                path, query = self._q()
+                if not self._auth_ok(path, query):
+                    return self._reply(403, b"<Error>AuthenticationFailed</Error>")
+                parts = urllib.parse.unquote(path).lstrip("/").split("/", 1)
+                container = parts[0]
+                blob = parts[1] if len(parts) > 1 else ""
+                with emu.lock:
+                    return self._dispatch(container, blob, query, body)
+
+            def _dispatch(self, container, blob, query, body):
+                cmd = self.command
+                store = emu.containers.get(container)
+                if cmd == "PUT" and query.get("restype") == "container":
+                    if store is None:
+                        emu.containers[container] = {}
+                        return self._reply(201)
+                    return self._reply(409)
+                if store is None:
+                    return self._reply(404, b"<Error>ContainerNotFound</Error>")
+                if cmd == "GET" and query.get("comp") == "list":
+                    return self._list(container, store, query)
+                if cmd == "PUT" and query.get("comp") == "block":
+                    emu.blocks.setdefault((container, blob), {})[
+                        query["blockid"]] = body
+                    return self._reply(201)
+                if cmd == "PUT" and query.get("comp") == "blocklist":
+                    import re
+                    ids = re.findall(r"<Latest>([^<]+)</Latest>",
+                                     body.decode())
+                    blks = emu.blocks.pop((container, blob), {})
+                    store[blob] = b"".join(blks.get(i, b"") for i in ids)
+                    return self._reply(201)
+                if cmd == "PUT" and "x-ms-copy-source" in self.headers:
+                    src = urllib.parse.unquote(urllib.parse.urlsplit(
+                        self.headers["x-ms-copy-source"]).path)
+                    sc, sb = src.lstrip("/").split("/", 1)
+                    data = emu.containers.get(sc, {}).get(sb)
+                    if data is None:
+                        return self._reply(404)
+                    store[blob] = data
+                    return self._reply(202, headers={"x-ms-copy-status": "success"})
+                if cmd == "PUT":
+                    store[blob] = body
+                    return self._reply(201)
+                if cmd in ("GET", "HEAD"):
+                    data = store.get(blob)
+                    if data is None:
+                        return self._reply(404, b"<Error>BlobNotFound</Error>")
+                    rng = self.headers.get("x-ms-range") or self.headers.get("Range")
+                    code = 200
+                    if rng and rng.startswith("bytes="):
+                        s, _, e = rng[6:].partition("-")
+                        start = int(s)
+                        end = int(e) if e else len(data) - 1
+                        data = data[start:end + 1]
+                        code = 206
+                    return self._reply(code, data, headers={
+                        "Last-Modified": "Thu, 01 Jan 1970 00:00:01 GMT",
+                        "x-ms-blob-type": "BlockBlob",
+                    })
+                if cmd == "DELETE":
+                    if store.pop(blob, None) is None:
+                        return self._reply(404)
+                    return self._reply(202)
+                return self._reply(400, b"<Error>Unsupported</Error>")
+
+            def _list(self, container, store, query):
+                prefix = query.get("prefix", "")
+                marker = query.get("marker", "")
+                maxr = int(query.get("maxresults", "1000"))
+                names = sorted(n for n in store
+                               if n.startswith(prefix) and n > marker)
+                page, rest = names[:maxr], names[maxr:]
+                items = "".join(
+                    f"<Blob><Name>{n}</Name><Properties>"
+                    f"<Content-Length>{len(store[n])}</Content-Length>"
+                    f"<Last-Modified>Thu, 01 Jan 1970 00:00:01 GMT"
+                    f"</Last-Modified></Properties></Blob>"
+                    for n in page
+                )
+                nm = f"<NextMarker>{page[-1]}</NextMarker>" if rest else "<NextMarker/>"
+                xml = (f"<?xml version=\"1.0\"?><EnumerationResults>"
+                       f"<Blobs>{items}</Blobs>{nm}</EnumerationResults>")
+                return self._reply(200, xml.encode())
+
+            def do_GET(self):
+                self._handle(b"")
+
+            do_HEAD = do_DELETE = do_GET
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self._handle(self.rfile.read(n))
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+        return self._srv.server_port
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()

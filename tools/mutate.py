@@ -16,11 +16,12 @@ Mutators (classic first-order set):
     bool  swap and/or; drop `not`
     arith +/- swap, *// swap
     const integer off-by-one (skips 0/1-as-index-ish small literals)
-    ret   `return X` -> `return None` in non-None-returning spots
 
-Deterministic: mutants are enumerated in source order; --seed/--sample
-picks a reproducible subset. Timeout per mutant kills hangs (an
-infinite-loop mutant counts as killed).
+Deterministic: mutants are enumerated in source order; --seed with
+--max-mutants picks a reproducible subset. Timeout per mutant kills
+hangs (an infinite-loop mutant counts as killed). A pre-flight
+UNMUTATED run must pass, or every mutant would be reported killed by a
+broken test mapping.
 """
 
 from __future__ import annotations
@@ -199,6 +200,19 @@ def main() -> int:
         key = args.target.rsplit(".", 1)[0]
         tests = TEST_MAP.get(key, DEFAULT_TESTS)
     print(f"tests per mutant: {tests}")
+
+    # pre-flight: the UNMUTATED tests must pass (a broken mapping or an
+    # already-red suite would report a meaningless 100% kill rate)
+    base = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q", "--no-header",
+         "-p", "no:cacheprovider"] + tests,
+        cwd=REPO, capture_output=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    if base.returncode != 0:
+        print(f"baseline run FAILED (pytest rc {base.returncode}) — fix the "
+              f"test mapping first:\n{base.stdout.decode()[-800:]}")
+        return 2
 
     chosen = list(range(len(sites)))
     if args.max_mutants and args.max_mutants < len(chosen):
