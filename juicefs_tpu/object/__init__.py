@@ -50,6 +50,12 @@ def _s3_factory(addr: str) -> ObjectStorage:
     return S3Storage(addr)
 
 
+def _gs_factory(addr: str) -> ObjectStorage:
+    from .gs import GSStorage
+
+    return GSStorage(addr)
+
+
 def _azure_factory(addr: str) -> ObjectStorage:
     from .azure import AzureBlobStorage
 
@@ -81,6 +87,7 @@ register("minio", _s3_factory)
 register("webdav", _webdav_factory)
 register("azure", _azure_factory)
 register("wasb", _azure_factory)
+register("gs", _gs_factory)
 register("sqlite3", _sqlite_factory)
 register("sqlite", _sqlite_factory)
 register("redis", _redis_obj_factory)

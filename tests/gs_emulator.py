@@ -1,0 +1,139 @@
+"""Minimal GCS JSON-API emulator for hermetic gs:// driver tests
+(plays fake-gcs-server's role; same pattern as the azure/s3 pairings).
+Implements exactly the subset object/gs.py speaks — bucket insert,
+media upload/download with Range, metadata, prefix list with pageToken,
+copyTo, compose — with Bearer-token verification."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class GSEmulator:
+    def __init__(self, token: str = "test-oauth-token"):
+        self.token = token
+        self.buckets: dict[str, dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+        self._srv = None
+
+    def start(self) -> int:
+        emu = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, body=b"", ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _handle(self, body: bytes):
+                if self.headers.get("Authorization") != f"Bearer {emu.token}":
+                    return self._reply(401, b'{"error":"unauthorized"}')
+                u = urllib.parse.urlsplit(self.path)
+                q = dict(urllib.parse.parse_qsl(u.query))
+                seg = [urllib.parse.unquote(x) for x in u.path.split("/") if x]
+                with emu.lock:
+                    return self._dispatch(seg, q, body)
+
+            def _dispatch(self, seg, q, body):
+                # /storage/v1/b                               bucket insert
+                if seg[:3] == ["storage", "v1", "b"] and len(seg) == 3 \
+                        and self.command == "POST":
+                    name = json.loads(body)["name"]
+                    if name in emu.buckets:
+                        return self._reply(409)
+                    emu.buckets[name] = {}
+                    return self._reply(200, b"{}")
+                # /upload/storage/v1/b/{b}/o?uploadType=media&name=
+                if seg[:1] == ["upload"]:
+                    bkt = emu.buckets.get(seg[4])
+                    if bkt is None:
+                        return self._reply(404)
+                    bkt[q["name"]] = body
+                    return self._reply(200, json.dumps(
+                        {"name": q["name"], "size": str(len(body))}).encode())
+                bkt = emu.buckets.get(seg[3]) if len(seg) > 3 else None
+                if bkt is None:
+                    return self._reply(404)
+                # /storage/v1/b/{b}/o                         list
+                if len(seg) == 5 and seg[4] == "o" and self.command == "GET":
+                    prefix = q.get("prefix", "")
+                    maxr = int(q.get("maxResults", "1000"))
+                    after = q.get("pageToken", "")
+                    names = sorted(n for n in bkt
+                                   if n.startswith(prefix) and n > after)
+                    page, rest = names[:maxr], names[maxr:]
+                    doc = {"items": [
+                        {"name": n, "size": str(len(bkt[n])),
+                         "updated": "1970-01-01T00:00:01Z"} for n in page]}
+                    if rest:
+                        doc["nextPageToken"] = page[-1]
+                    return self._reply(200, json.dumps(doc).encode())
+                obj = seg[5] if len(seg) > 5 else ""
+                # compose: /storage/v1/b/{b}/o/{dst}/compose
+                if len(seg) == 7 and seg[6] == "compose":
+                    srcs = json.loads(body)["sourceObjects"]
+                    try:
+                        bkt[obj] = b"".join(bkt[s["name"]] for s in srcs)
+                    except KeyError:
+                        return self._reply(404)
+                    return self._reply(200, b"{}")
+                # copyTo: /storage/v1/b/{b}/o/{src}/copyTo/b/{b2}/o/{dst}
+                if len(seg) >= 11 and seg[6] == "copyTo":
+                    data = bkt.get(obj)
+                    if data is None:
+                        return self._reply(404)
+                    dstb = emu.buckets.get(seg[8])
+                    if dstb is None:
+                        return self._reply(404)
+                    dstb[seg[10]] = data
+                    return self._reply(200, b"{}")
+                if obj not in bkt and self.command != "DELETE":
+                    return self._reply(404)
+                if self.command == "GET" and q.get("alt") == "media":
+                    data = bkt[obj]
+                    rng = self.headers.get("Range")
+                    code = 200
+                    if rng and rng.startswith("bytes="):
+                        s, _, e = rng[6:].partition("-")
+                        start = int(s)
+                        end = int(e) if e else len(data) - 1
+                        data = data[start:end + 1]
+                        code = 206
+                    return self._reply(code, data,
+                                       "application/octet-stream")
+                if self.command == "GET":  # metadata
+                    return self._reply(200, json.dumps(
+                        {"name": obj, "size": str(len(bkt[obj])),
+                         "updated": "1970-01-01T00:00:01Z"}).encode())
+                if self.command == "DELETE":
+                    return self._reply(
+                        204 if bkt.pop(obj, None) is not None else 404)
+                return self._reply(400)
+
+            def do_GET(self):
+                self._handle(b"")
+
+            do_DELETE = do_GET
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self._handle(self.rfile.read(n))
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+        return self._srv.server_port
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
