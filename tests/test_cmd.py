@@ -517,3 +517,70 @@ def test_usage_reporter_fail_silent():
     pl = r.payload()
     assert set(pl) == {"uuid", "version", "usedSpace", "usedInodes",
                        "metaEngine", "storage"}
+
+
+def test_cli_tools_over_relational_engine(tmp_path, capsys):
+    """Every maintenance tool works against the sql:// engine family:
+    format, write via VFS, gc --dedup (content index), fsck, dump/load
+    migration to a KV engine, status, quota."""
+    import json as _json
+    import os
+
+    from juicefs_tpu.cmd import main
+
+    meta = f"sql://{tmp_path}/rel.db"
+    blob_dir = f"{tmp_path}/blob"
+    assert main(["format", meta, "relvol", "--storage", f"file://{blob_dir}",
+                 "--trash-days", "0", "--hash-backend", "cpu"]) == 0
+    capsys.readouterr()
+
+    # write some data through the full stack
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.cmd import open_meta, storage_for
+    from juicefs_tpu.meta.context import Context
+    from juicefs_tpu.vfs import VFS
+
+    ctx = Context(uid=0, gid=0)
+    m, fmt = open_meta(meta)
+    from juicefs_tpu.cmd import build_store, chunk_conf
+
+    store = build_store(fmt, meta=m)  # wires the cpu-hash content indexer
+    v = VFS(m, store)
+    payload = os.urandom(600_000)
+    st, ino, _, fh = v.create(ctx, 1, b"data.bin", 0o644)
+    v.write(ctx, ino, fh, 0, payload)
+    v.flush(ctx, ino, fh)
+    store.flush_all()
+    v.release(ctx, ino, fh)
+    v.close()
+    m.shutdown()
+
+    assert main(["gc", meta, "--dedup"]) == 0
+    out = capsys.readouterr().out
+    dedup = _json.loads(out.strip().splitlines()[-1])
+    assert dedup["blocks"] == 1 and dedup["bytes"] == len(payload)
+    assert dedup["from_index"] == 1  # the write path indexed it (cpu)
+
+    assert main(["fsck", meta]) == 0
+    capsys.readouterr()
+    assert main(["status", meta]) == 0
+    capsys.readouterr()
+    assert main(["quota", "set", meta, "/", "--space", "1024"]) == 0
+    capsys.readouterr()
+
+    # migrate to the KV family via dump/load and read the file back
+    dump_file = str(tmp_path / "mig.json")
+    assert main(["dump", meta, dump_file]) == 0
+    capsys.readouterr()
+    kv_meta = f"sqlite3://{tmp_path}/kv.db"
+    assert main(["load", kv_meta, dump_file]) == 0
+    capsys.readouterr()
+    m2, fmt2 = open_meta(kv_meta)
+    store2 = CachedStore(storage_for(fmt2), chunk_conf(fmt2))
+    v2 = VFS(m2, store2)
+    st, ino2, attr = v2.lookup(ctx, 1, b"data.bin")
+    assert st == 0 and attr.length == len(payload)
+    st, _, fh2 = v2.open(ctx, ino2, os.O_RDONLY)
+    st, got = v2.read(ctx, ino2, fh2, 0, len(payload))
+    assert st == 0 and bytes(got) == payload
+    v2.close()
