@@ -46,9 +46,14 @@ def add_parser(sub):
     p.add_argument("--graphite", default="",
                    help="host:port to stream Graphite plaintext metrics to")
     p.add_argument("--push-interval", type=float, default=10.0)
+    p.add_argument("--usage-report-url", default="",
+                   help="opt in to a daily anonymous usage ping POSTed to "
+                        "this operator-owned URL (reference "
+                        "pkg/usage/usage.go reports by default; this build "
+                        "sends NOTHING unless a URL is given)")
     p.add_argument("--no-usage-report", action="store_true",
-                   help="disable the anonymous daily usage ping "
-                        "(reference pkg/usage/usage.go)")
+                   help="kept for fstab compatibility; reporting is "
+                        "already off unless --usage-report-url is set")
     p.add_argument("--takeover", action="store_true",
                    help="seamless upgrade: adopt a running mount's fuse fd, "
                         "open handles, and session (reference passfd.go)")
@@ -149,10 +154,11 @@ def serve(args) -> int:
             job=fmt.name,
         )
     usage = None
-    if not getattr(args, "no_usage_report", False):
+    report_url = getattr(args, "usage_report_url", "")
+    if report_url and not getattr(args, "no_usage_report", False):
         from ..metric.usage import UsageReporter
 
-        usage = UsageReporter(m, fmt)
+        usage = UsageReporter(m, fmt, url=report_url)
     srv = Server(vfs, args.mountpoint, fsname=f"juicefs-tpu:{fmt.name}",
                  allow_other=args.allow_other,
                  writeback_cache=not getattr(args, "no_kernel_writeback", False))

@@ -1907,10 +1907,20 @@ class KVMeta(BaseMeta):
                     if (l[0] != me[0] or l[1] != me[1]) and l[3] < end and l[4] > start:
                         if ltype == self.F_WRLCK or l[2] == self.F_WRLCK:
                             return errno.EAGAIN
-                locks = [
-                    l for l in locks
-                    if not (l[0] == me[0] and l[1] == me[1] and start <= l[3] and l[4] <= end)
-                ]
+                # Split own partially-overlapping locks like F_UNLCK does,
+                # so a lock of a different type over a subrange replaces the
+                # overlap (POSIX downgrade/upgrade) instead of leaving the
+                # old row to shadow it.
+                keep, remains = [], []
+                for l in locks:
+                    if l[0] == me[0] and l[1] == me[1] and l[3] < end and l[4] > start:
+                        if l[3] < start:
+                            remains.append([l[0], l[1], l[2], l[3], start, l[5]])
+                        if l[4] > end:
+                            remains.append([l[0], l[1], l[2], end, l[4], l[5]])
+                    else:
+                        keep.append(l)
+                locks = keep + remains
                 locks.append([me[0], me[1], ltype, start, end, pid])
             if locks:
                 tx.set(key, json.dumps(locks).encode())

@@ -1979,11 +1979,29 @@ class SQLMeta(BaseMeta):
                 ).fetchone()
                 if conflict:
                     return errno.EAGAIN
-                cur.execute(
-                    "DELETE FROM plock WHERE inode=? AND sid=? AND owner=? "
-                    "AND start>=? AND end<=?",
-                    (ino, self.sid, owner, start, end),
-                )
+                # Split own partially-overlapping locks like the F_UNLCK
+                # path does, so e.g. a read-lock over a subrange of an own
+                # write lock downgrades that subrange (POSIX) instead of
+                # leaving the old write-lock row to shadow it.
+                mine = cur.execute(
+                    "SELECT rowid, ltype, start, end, pid FROM plock "
+                    "WHERE inode=? AND sid=? AND owner=? AND start<? AND end>?",
+                    (ino, self.sid, owner, end, start),
+                ).fetchall()
+                for rowid, lt, ls, le, lpid in mine:
+                    cur.execute("DELETE FROM plock WHERE rowid=?", (rowid,))
+                    if ls < start:
+                        cur.execute(
+                            "INSERT INTO plock (inode,sid,owner,ltype,start,end,pid) "
+                            "VALUES (?,?,?,?,?,?,?)",
+                            (ino, self.sid, owner, lt, ls, start, lpid),
+                        )
+                    if le > end:
+                        cur.execute(
+                            "INSERT INTO plock (inode,sid,owner,ltype,start,end,pid) "
+                            "VALUES (?,?,?,?,?,?,?)",
+                            (ino, self.sid, owner, lt, end, le, lpid),
+                        )
                 cur.execute(
                     "INSERT INTO plock (inode,sid,owner,ltype,start,end,pid) "
                     "VALUES (?,?,?,?,?,?,?)",

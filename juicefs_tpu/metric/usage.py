@@ -1,10 +1,14 @@
 """Anonymous usage ping (reference pkg/usage/usage.go:70 reportUsage).
 
 Once a day, a mount POSTs a small anonymous JSON document (volume uuid,
-client version, aggregate usage) to the report endpoint. Strictly
-best-effort and fail-silent — networking problems or an air-gapped host
-must never affect the mount — and disabled entirely with
-`mount --no-usage-report`.
+client version, aggregate usage) to an OPERATOR-SUPPLIED endpoint.
+
+This diverges from the reference deliberately: the reference phones home
+to its vendor's endpoint by default; this project does not own that
+endpoint, so the ping is strictly OPT-IN — no URL is built in, and
+nothing is sent unless `mount --usage-report-url URL` names a collector
+the operator controls. When enabled it is best-effort and fail-silent:
+networking problems or an air-gapped host must never affect the mount.
 """
 
 from __future__ import annotations
@@ -13,13 +17,14 @@ import json
 import threading
 import urllib.request
 
-USAGE_URL = "https://juicefs.com/report-usage"  # reference usage.go endpoint
 INTERVAL = 86400.0
 
 
 class UsageReporter:
-    def __init__(self, meta, fmt, url: str = USAGE_URL,
+    def __init__(self, meta, fmt, url: str,
                  interval: float = INTERVAL):
+        if not url:
+            raise ValueError("usage reporting requires an explicit URL")
         self.meta = meta
         self.fmt = fmt
         self.url = url

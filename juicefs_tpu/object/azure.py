@@ -21,10 +21,12 @@ s3/minio pairing.
 from __future__ import annotations
 
 import base64
+import bisect
 import datetime
 import hashlib
 import hmac
 import http.client
+import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Iterator, Optional
@@ -132,6 +134,9 @@ class AzureBlobStorage(ObjectStorage):
         import threading
 
         self._local = threading.local()
+        # per-prefix [(last_key_of_page, NextMarker), ...] for resumed scans
+        self._list_ckpts: dict[str, list[tuple[str, str]]] = {}
+        self._ckpt_lock = threading.Lock()
 
     def string(self) -> str:
         return f"azure://{self.host}/{self.container}/"
@@ -225,17 +230,51 @@ class AzureBlobStorage(ObjectStorage):
     def copy(self, dst: str, src: str) -> None:
         src_url = (f"http{'s' if self.tls else ''}://{self.host}:{self.port}"
                    + self._blob_path(src))
-        st, data, _ = self._request(
+        st, data, h = self._request(
             "PUT", self._blob_path(dst),
             headers={"x-ms-copy-source": src_url},
         )
         self._check(st, data, dst)
+        # Copy Blob is asynchronous: a 202 may carry copy-status "pending",
+        # and a GET of dst before completion can see a missing/partial
+        # blob. Poll Get Blob Properties until "success" (ADVICE r4).
+        status = {k.lower(): v for k, v in h.items()}.get(
+            "x-ms-copy-status", "success")
+        deadline = time.monotonic() + 300.0
+        delay = 0.05
+        while status == "pending":
+            if time.monotonic() > deadline:
+                raise IOError(f"azure copy {src} -> {dst}: still pending "
+                              "after 300s")
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+            st, data, h = self._request("HEAD", self._blob_path(dst))
+            self._check(st, data, dst)
+            status = {k.lower(): v for k, v in h.items()}.get(
+                "x-ms-copy-status", "success")
+        if status != "success":
+            raise IOError(f"azure copy {src} -> {dst}: status {status}")
 
     def list_all(self, prefix: str = "", marker: str = "") -> Iterator[Obj]:
+        # Azure's flat listing has no startOffset analog (the service
+        # `marker` is an opaque continuation token, not a key), so a key
+        # marker cannot seed the scan directly. Instead each page's
+        # NextMarker is checkpointed against the last key it covered;
+        # a resumed scan (sync/gc restart in this process) seeds the
+        # service-side marker from the best checkpoint <= the resume key
+        # rather than re-walking the container from the start (ADVICE r4).
         full_prefix = (f"{self.prefix}/{prefix}" if self.prefix else prefix)
         strip = len(self.prefix) + 1 if self.prefix else 0
         next_marker = ""
         started = not marker
+        seeded = False
+        with self._ckpt_lock:
+            ckpts = self._list_ckpts.setdefault(full_prefix, [])
+            if marker and ckpts:
+                i = bisect.bisect_right(ckpts, (marker, chr(0x10FFFF))) - 1
+                if i >= 0:
+                    next_marker = ckpts[i][1]
+                    seeded = True
         while True:
             q = {"restype": "container", "comp": "list",
                  "maxresults": "1000"}
@@ -244,8 +283,20 @@ class AzureBlobStorage(ObjectStorage):
             if next_marker:
                 q["marker"] = next_marker
             st, data, _ = self._request("GET", f"/{self.container}", q)
+            if seeded and st >= 300:
+                # the checkpointed continuation token went stale (container
+                # recreated, token expired): resume is best-effort — drop
+                # the checkpoints and degrade to a full re-walk
+                with self._ckpt_lock:
+                    self._list_ckpts.pop(full_prefix, None)
+                    ckpts = self._list_ckpts.setdefault(full_prefix, [])
+                seeded = False
+                next_marker = ""
+                continue
+            seeded = False
             self._check(st, data, "list")
             root = ET.fromstring(data)
+            key = ""
             for b in root.iter("Blob"):
                 name = b.findtext("Name", "")
                 key = name[strip:]
@@ -266,6 +317,10 @@ class AzureBlobStorage(ObjectStorage):
             next_marker = root.findtext("NextMarker", "")
             if not next_marker:
                 return
+            with self._ckpt_lock:
+                if key and (not ckpts or key > ckpts[-1][0]):
+                    ckpts.append((key, next_marker))
+                    del ckpts[:-1024]  # bound the memory per prefix
 
     # -- multipart (Put Block / Put Block List) ---------------------------
     def create_multipart_upload(self, key: str) -> Optional[MultipartUpload]:

@@ -123,7 +123,8 @@ class _Encrypted(ObjectStorage):
 
 
 class ECIESEncryptor:
-    """EC key encryptor (reference encrypt.go:136-145 eciesEncryptor):
+    """EC key encryptor — an EXTENSION of this build, not reference parity
+    (reference encrypt.go wraps keys with RSA-OAEP only; it has no ECIES):
     ephemeral-ECDH over P-256 + HKDF-SHA256 derives a wrapping key, the
     data key travels AES-GCM-sealed beside the ephemeral public key.
 
@@ -196,9 +197,15 @@ def generate_ec_key_pem(password: bytes | None = None) -> bytes:
 
 
 class AESCTRDataEncryptor(AESGCMDataEncryptor):
-    """AES-256-CTR body variant (reference encrypt.go aes256ctr option):
-    no per-object auth tag — pair with the checksummed wrapper when
-    integrity matters; CTR exists for backends that pre-verify content."""
+    """AES-256-CTR body variant — an EXTENSION of this build, not reference
+    parity (reference encrypt.go offers AEAD bodies only: aes256gcm-rsa and
+    chacha20-rsa; no CTR mode exists there). CTR has no per-object auth tag,
+    so ciphertext is malleable; `new_encrypted` therefore refuses to build a
+    bare-CTR stack and always interposes the CRC32C checksummed wrapper
+    between the cipher and the store, so every full-object GET verifies the
+    ciphertext before decrypt. That catches corruption and blind bit-flips;
+    operators needing cryptographic tamper resistance must use the GCM
+    default."""
 
     def encrypt(self, plaintext: bytes) -> bytes:
         from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
@@ -241,8 +248,14 @@ def _key_encryptor(pem: bytes, password: bytes | None):
 def new_encrypted(store: ObjectStorage, pem: bytes,
                   password: bytes | None = None,
                   algo: str = "aes256gcm") -> ObjectStorage:
-    """Envelope-encrypt a store. algo: aes256gcm (default) | aes256ctr.
-    The key side (RSA-OAEP vs ECIES) follows the PEM key type."""
+    """Envelope-encrypt a store. algo: aes256gcm (default, reference
+    parity) | aes256ctr (extension; forcibly paired with the CRC32C
+    checksummed wrapper — see AESCTRDataEncryptor). The key side
+    (RSA-OAEP per the reference, or the ECIES extension) follows the
+    PEM key type."""
     ke = _key_encryptor(pem, password)
-    cls = AESCTRDataEncryptor if algo.startswith("aes256ctr") else AESGCMDataEncryptor
-    return _Encrypted(store, cls(ke))
+    if algo.startswith("aes256ctr"):
+        from .checksum import new_checksummed
+
+        return _Encrypted(new_checksummed(store), AESCTRDataEncryptor(ke))
+    return _Encrypted(store, AESGCMDataEncryptor(ke))

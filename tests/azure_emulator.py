@@ -26,6 +26,12 @@ class AzureEmulator:
         self.signer = SharedKey(account, key_b64)
         self.containers: dict[str, dict[str, bytes]] = {}
         self.blocks: dict[tuple[str, str], dict[str, bytes]] = {}
+        # async Copy Blob emulation: >0 makes each copy report "pending"
+        # for that many property polls before the blob materializes
+        self.copy_pending_polls = 0
+        self._pending: dict[tuple[str, str], list] = {}  # (cont,blob)->[n,data]
+        self.page_cap = 0  # >0 caps the List Blobs page size
+        self.list_calls: list[str] = []  # marker of each List Blobs request
         self.lock = threading.Lock()
         self._srv = None
 
@@ -101,12 +107,29 @@ class AzureEmulator:
                     data = emu.containers.get(sc, {}).get(sb)
                     if data is None:
                         return self._reply(404)
+                    if emu.copy_pending_polls > 0:
+                        # async copy: dst not visible until polled to done
+                        emu._pending[(container, blob)] = [
+                            emu.copy_pending_polls, data]
+                        return self._reply(
+                            202, headers={"x-ms-copy-status": "pending"})
                     store[blob] = data
                     return self._reply(202, headers={"x-ms-copy-status": "success"})
                 if cmd == "PUT":
                     store[blob] = body
                     return self._reply(201)
                 if cmd in ("GET", "HEAD"):
+                    pend = emu._pending.get((container, blob))
+                    if pend is not None:
+                        pend[0] -= 1
+                        if pend[0] > 0:
+                            return self._reply(200, headers={
+                                "x-ms-copy-status": "pending",
+                                "Last-Modified":
+                                    "Thu, 01 Jan 1970 00:00:01 GMT",
+                            })
+                        del emu._pending[(container, blob)]
+                        store[blob] = pend[1]
                     data = store.get(blob)
                     if data is None:
                         return self._reply(404, b"<Error>BlobNotFound</Error>")
@@ -132,6 +155,9 @@ class AzureEmulator:
                 prefix = query.get("prefix", "")
                 marker = query.get("marker", "")
                 maxr = int(query.get("maxresults", "1000"))
+                if emu.page_cap:
+                    maxr = min(maxr, emu.page_cap)
+                emu.list_calls.append(marker)
                 names = sorted(n for n in store
                                if n.startswith(prefix) and n > marker)
                 page, rest = names[:maxr], names[maxr:]

@@ -517,6 +517,9 @@ def test_usage_reporter_fail_silent():
     pl = r.payload()
     assert set(pl) == {"uuid", "version", "usedSpace", "usedInodes",
                        "metaEngine", "storage"}
+    # opt-in only: there is no built-in endpoint to default to
+    with pytest.raises(ValueError):
+        UsageReporter(m, fmt, url="")
 
 
 def test_cli_tools_over_relational_engine(tmp_path, capsys):

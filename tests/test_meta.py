@@ -408,6 +408,29 @@ def test_setlk(m):
     assert m.setlk(CTX, ino, owner=2, ltype=W, start=0, end=50) == 0
 
 
+def test_setlk_downgrade_splits_own_lock(m):
+    """POSIX: re-locking a subrange REPLACES the overlap, even when the
+    new lock's type differs (ADVICE r4: a W->R downgrade used to leave
+    the old write-lock row alive because acquire only deleted own locks
+    fully contained in the new range)."""
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"plk2", 0o644)
+    m.close(CTX, ino)
+    W, R, U = m.F_WRLCK, m.F_RDLCK, m.F_UNLCK
+    assert m.setlk(CTX, ino, owner=1, ltype=W, start=0, end=100) == 0
+    # downgrade the middle to a read lock
+    assert m.setlk(CTX, ino, owner=1, ltype=R, start=20, end=40) == 0
+    # another owner can now share-read [20,40) ...
+    assert m.setlk(CTX, ino, owner=2, ltype=R, start=20, end=40) == 0
+    # ... and getlk over the subrange reports a read lock, not W
+    st, lt, _, _, _ = m.getlk(CTX, ino, owner=3, ltype=W, start=20, end=40)
+    assert st == 0 and lt == R
+    # the flanks [0,20) and [40,100) stay write-locked
+    assert m.setlk(CTX, ino, owner=2, ltype=R, start=0, end=20) == errno.EAGAIN
+    assert m.setlk(CTX, ino, owner=2, ltype=R, start=40, end=100) == errno.EAGAIN
+    m.setlk(CTX, ino, owner=2, ltype=U, start=0, end=200)
+    m.setlk(CTX, ino, owner=1, ltype=U, start=0, end=200)
+
+
 def test_trash(tmp_path):
     c = new_client(f"sqlite3://{tmp_path}/trash.db")
     c.init(Format(name="t", trash_days=1), force=True)
