@@ -308,9 +308,14 @@ class WSlice:
                 buf = bytearray()
                 self._blocks[indx] = buf
             n = min(len(mv), self.bs - boff)
-            if boff + n > len(buf):
-                buf.extend(b"\x00" * (boff + n - len(buf)))
-            buf[boff : boff + n] = mv[:n]
+            if boff == len(buf):
+                # sequential append (the dominant shape): one copy, no
+                # zero-fill pass
+                buf += mv[:n]
+            else:
+                if boff + n > len(buf):
+                    buf.extend(bytes(boff + n - len(buf)))
+                buf[boff : boff + n] = mv[:n]
             mv = mv[n:]
             pos += n
         self._length = max(self._length, pos)
@@ -398,6 +403,13 @@ class RSlice:
         if off >= self.length or size <= 0:
             return b""
         size = min(size, self.length - off)
+        indx, boff = divmod(off, self.bs)
+        if boff + size <= self._block_size(indx):
+            # fast path: one block, cache hit — return a zero-copy view
+            # into the cached buffer (blocks are immutable once stored)
+            cached = self.store.cache.load(block_key(self.id, indx, self._block_size(indx)))
+            if cached is not None:
+                return memoryview(cached)[boff : boff + size]
         # plan the block segments covering [off, off+size)
         segs: list[tuple[int, int, int, int]] = []  # (indx, bsize, boff, n)
         pos = off

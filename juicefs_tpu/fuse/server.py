@@ -1,8 +1,9 @@
 """FUSE server: /dev/fuse request loop dispatching to the VFS.
 
 Role-equivalent to the reference's pkg/fuse/fuse.go (RawFileSystem methods
-delegating 1:1 to VFS, Serve loop :432-510): one reader thread parses
-kernel requests, a worker pool executes them against the VFS, replies are
+delegating 1:1 to VFS, Serve loop :432-510): a set of worker threads each
+pulls requests off the (non-blocking) device fd and executes them inline
+against the VFS — the libfuse multithreaded-loop shape — and replies are
 serialized back to the device. The caller identity (uid/gid/pid) of every
 request becomes the meta Context, so permission checks happen with the
 real requester, exactly like the reference's newContext (pkg/fuse/context.go).
@@ -15,8 +16,6 @@ import os
 import stat as _stat
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
-
 from ..meta.context import Context
 from ..meta.types import Attr, type_to_stat_mode
 from ..utils import get_logger
@@ -75,12 +74,13 @@ class Server:
         self._fd = -1
         self._wlock = threading.Lock()
         self._nlock = threading.Lock()  # notify writes; never _wlock (see _notify)
-        self._pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="fuse")
         self._stop = threading.Event()
         self._workers = workers
         self._writeback_cache = writeback_cache  # offered; INIT decides
         self._paused = threading.Event()   # takeover: stop pulling requests
-        self._quiet = threading.Event()    # loop acknowledged the pause
+        self._quiet = threading.Event()    # ALL loops acknowledged the pause
+        self._quiet_set: set[int] = set()  # loop thread ids parked in pause
+        self._quiet_lock = threading.Lock()
         self.handed_over = False           # fd given away: do not unmount
         self._takeover_listener = None
         # blocked SETLKW waiters (unique -> abort event): they live outside
@@ -144,39 +144,66 @@ class Server:
         )
 
     def serve(self) -> None:
-        """Blocking request loop; returns after unmount or handover."""
-        import select
+        """Blocking request loop; returns after unmount or handover.
 
+        Multi-threaded libfuse-style: `workers` threads each pull
+        requests off /dev/fuse and execute them INLINE (no pool
+        handoff — the submit/wakeup latency used to dominate warm
+        cache hits). The fd is non-blocking so a select wakeup that
+        another worker already consumed cannot strand a thread in
+        os.read past a pause/stop."""
         if self._fd < 0:
             self.mount()
+        os.set_blocking(self._fd, False)
+        extra = [
+            threading.Thread(target=self._serve_loop, daemon=True,
+                             name=f"fuse-{i}")
+            for i in range(max(self._workers - 1, 0))
+        ]
+        for t in extra:
+            t.start()
+        self._serve_loop()
+        for t in extra:
+            t.join(timeout=5.0)
+        if not self.handed_over:
+            self.vfs.flush_all()
+
+    def _serve_loop(self) -> None:
+        import select
+
         bufsize = MAX_WRITE + 4096
         fd = self._fd
+        me = threading.get_ident()
+        n = max(self._workers, 1)
         while not self._stop.is_set():
+            if self._paused.is_set():
+                with self._quiet_lock:
+                    self._quiet_set.add(me)
+                    if len(self._quiet_set) >= n:
+                        self._quiet.set()  # takeover thread may proceed
+                time.sleep(0.05)
+                continue
             # poll with timeout so pause/stop are honored even while the
             # kernel is idle (needed for the takeover handshake)
             try:
                 ready, _, _ = select.select([fd], [], [], 0.5)
-            except OSError:
+            except (OSError, ValueError):
                 break
-            if self._paused.is_set():
-                self._quiet.set()  # takeover thread may proceed
-                time.sleep(0.05)
-                continue
             if not ready:
                 continue
             try:
                 req = os.read(fd, bufsize)
+            except BlockingIOError:
+                continue  # another worker won the race for this request
             except OSError as e:
-                if e.errno == _errno.EINTR:
+                if e.errno in (_errno.EINTR, _errno.EAGAIN):
                     continue
                 if e.errno in (_errno.ENODEV, _errno.EBADF):
                     break  # unmounted
                 raise
             if not req:
                 break
-            self._pool.submit(self._dispatch, req)
-        if not self.handed_over:
-            self.vfs.flush_all()
+            self._dispatch(req)
 
     def serve_background(self) -> threading.Thread:
         self.mount()
@@ -233,10 +260,9 @@ class Server:
                     return
                 except Exception as e:
                     logger.error("takeover failed: %s", e)
-                    # resume serving: fresh pool (the old one was drained)
-                    self._pool = ThreadPoolExecutor(
-                        max_workers=self._workers, thread_name_prefix="fuse"
-                    )
+                    # resume serving: unpark the worker loops
+                    with self._quiet_lock:
+                        self._quiet_set.clear()
                     self._quiet.clear()
                     self._paused.clear()
                 finally:
@@ -249,9 +275,9 @@ class Server:
 
         logger.info("takeover requested: pausing request loop")
         self._paused.set()
-        self._quiet.wait(10.0)  # serve loop acknowledged
-        # drain in-flight ops, then make all buffered data durable
-        self._pool.shutdown(wait=True)
+        # every worker loop parked = no request in flight (dispatch is
+        # inline, so a parked loop cannot be executing one)
+        self._quiet.wait(10.0)
         # interrupt parked SETLKW waiters: they reply EINTR themselves
         # before we give the connection away
         with self._lkw_lock:

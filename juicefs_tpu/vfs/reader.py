@@ -40,8 +40,13 @@ class FileReader:
         self._lock = threading.Lock()
         self._last_end = -1
         self._ra_window = 0
+        self._ra_done = 0  # readahead already enqueued up to this offset
 
     def read(self, ctx: Context, off: int, size: int) -> tuple[int, bytes]:
+        """Returns (errno, buffer). The buffer may be a zero-copy
+        memoryview into a cached block on the single-segment fast path —
+        callers (fuse writev reply, fs.pread accumulation) treat it as a
+        read-only bytes-like."""
         st, attr = self.dr.meta.getattr(ctx, self.ino)
         if st != 0:
             return st, b""
@@ -54,17 +59,27 @@ class FileReader:
             return 0, b""
         size = min(size, length - off)
 
-        out = bytearray()
-        pos = off
         end = off + size
-        while pos < end:
-            indx, coff = divmod(pos, CHUNK_SIZE)
-            n = min(end - pos, CHUNK_SIZE - coff)
-            st, data = self._read_chunk(indx, coff, n)
+        indx, coff = divmod(off, CHUNK_SIZE)
+        if coff + size <= CHUNK_SIZE:
+            # fast path: the read lives in one chunk — hand its buffer
+            # through without reassembly (the dominant shape: FUSE reads
+            # are <=1 MiB, chunks are 64 MiB)
+            st, out = self._read_chunk(indx, coff, size)
             if st != 0:
                 return st, b""
-            out += data
-            pos += n
+        else:
+            parts = []
+            pos = off
+            while pos < end:
+                indx, coff = divmod(pos, CHUNK_SIZE)
+                n = min(end - pos, CHUNK_SIZE - coff)
+                st, data = self._read_chunk(indx, coff, n)
+                if st != 0:
+                    return st, b""
+                parts.append(data)
+                pos += n
+            out = b"".join(parts)
 
         with self._lock:
             if off == self._last_end:
@@ -74,11 +89,18 @@ class FileReader:
                 )
             else:
                 self._ra_window = 0
+                self._ra_done = 0
             self._last_end = end
             window = self._ra_window
-        if window > 0 and end < length:
-            self._readahead(end, min(window, length - end))
-        return 0, bytes(out)
+            # only plan the part of the window not already enqueued —
+            # re-walking warmed blocks costs a meta read + queue churn
+            # per request (reference reader.go keeps per-session state)
+            ra_start = max(end, self._ra_done)
+            ra_end = min(end + window, length)
+            self._ra_done = max(self._ra_done, ra_end)
+        if window > 0 and ra_end > ra_start:
+            self._readahead(ra_start, ra_end - ra_start)
+        return 0, out
 
     def _read_chunk(self, indx: int, coff: int, size: int) -> tuple[int, bytes]:
         st, slices = self.dr.meta.read_chunk(self.ino, indx)
@@ -92,6 +114,12 @@ class FileReader:
             s1 = min(seg.pos + seg.len, end)
             if s0 < s1 and seg.id != 0:
                 segs.append((s0, s1, seg))
+        if len(segs) == 1 and segs[0][0] == coff and segs[0][1] == end:
+            # one slice covers the whole request, no holes: hand the
+            # store's buffer (often a zero-copy view of a cached block)
+            # straight through without the assembly bytearray
+            s0, s1, seg = segs[0]
+            return 0, self._read_seg(seg, s0, s1)
         out = bytearray(size)
         if len(segs) > 1:
             # fragmented chunk (the pre-compaction case: many small slices
@@ -112,7 +140,7 @@ class FileReader:
             s0, s1, seg = segs[0]
             data = self._read_seg(seg, s0, s1)
             out[s0 - coff : s0 - coff + len(data)] = data
-        return 0, bytes(out)
+        return 0, bytes(out)  # multi-seg/hole case: out was assembled here
 
     def _read_seg(self, seg, s0: int, s1: int) -> bytes:
         rs = self.dr.store.new_reader(seg.id, seg.size)

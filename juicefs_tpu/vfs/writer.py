@@ -177,7 +177,10 @@ class FileWriter:
                 cw = self.chunks.get(indx)
                 if cw is None:
                     cw = self.chunks[indx] = ChunkWriter(self, indx)
-                st = cw.write(coff, bytes(mv[:n]))
+                # pass the view through: WSlice.write_at copies into its
+                # block buffer, so a bytes() here would copy every byte
+                # twice
+                st = cw.write(coff, mv[:n])
                 if st != 0:
                     return st
                 mv = mv[n:]
