@@ -461,6 +461,13 @@ def run_e2e(gib: float, backends: list[str], block_mib: int = 4,
                          ("gibs", "seconds", "blocks_per_s", "from_index",
                           "stage_seconds")},
             }
+        # per-stage attribution from the registry's stage-latency
+        # histograms (juicefs_tpu_stage_seconds): chunk loads, object
+        # GET/PUT, tpu hash dispatch/drain — so BENCH_r*.json trajectories
+        # carry where the time went, not just headline GiB/s
+        from juicefs_tpu.metric.trace import stage_metrics_snapshot
+
+        out["stage_metrics"] = stage_metrics_snapshot()
         return out
     finally:
         if not keep_dir:

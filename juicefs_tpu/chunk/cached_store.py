@@ -17,12 +17,16 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..compress import new_compressor
+from ..metric import global_registry
+from ..metric.trace import global_tracer, stage_hist
 from ..object.interface import NotFoundError, ObjectStorage
+from ..object.metered import metered
 from ..utils import get_logger
 from .disk_cache import CacheManager, DiskCache
 from .mem_cache import MemCache
@@ -30,6 +34,42 @@ from .prefetch import Prefetcher
 from .singleflight import SingleFlight
 
 logger = get_logger("chunk.store")
+
+_TR = global_tracer()
+_RETRIES = global_registry().counter(
+    "juicefs_object_request_retries",
+    "Object requests retried after a transient failure",
+    ("method",),
+)
+_H_READ = stage_hist("chunk", "read", "total")
+_H_FETCH = stage_hist("chunk", "load", "fetch")
+_H_UPLOAD = stage_hist("chunk", "upload", "put")
+_H_STAGE = stage_hist("chunk", "upload", "stage")
+
+# staging backlog gauges (reference juicefs_staging_blocks/bytes) aggregate
+# over every live store — weak refs so a gauge closure never pins a
+# discarded store (gc/fsck builds then drops one) and multiple mounts sum
+_LIVE_STORES: "weakref.WeakSet[CachedStore]" = weakref.WeakSet()
+
+
+def _sum_staging(fn) -> float:
+    total = 0
+    try:
+        for s in list(_LIVE_STORES):
+            total += fn(s)
+    except Exception:
+        pass  # racing a store teardown must never break a scrape
+    return total
+
+
+global_registry().gauge(
+    "juicefs_staging_blocks", "Blocks staged for writeback upload"
+).set_function(lambda: _sum_staging(lambda s: len(s._pending_staged)))
+global_registry().gauge(
+    "juicefs_staging_bytes", "Bytes staged for writeback upload"
+).set_function(lambda: _sum_staging(
+    lambda s: sum(len(v) for v in list(s._pending_staged.values()))
+))
 
 
 def block_key(sid: int, indx: int, bsize: int) -> str:
@@ -70,13 +110,17 @@ class CachedStore:
     """reference cached_store.go:636 cachedStore / NewCachedStore:751"""
 
     def __init__(self, storage: ObjectStorage, config: ChunkConfig | None = None):
-        self.storage = storage
+        # the metering wrapper (object/metered.py) sits beneath the cache,
+        # above the wire driver — the true object boundary; idempotent
+        self.storage = metered(storage)
         self.conf = config or ChunkConfig()
         self.compressor = new_compressor(self.conf.compress)
         if self.conf.cache_dirs == ("memory",):
             self.cache = MemCache(self.conf.cache_size)
+            self.cache_tier = "mem"
         else:
             self.cache = CacheManager(list(self.conf.cache_dirs), self.conf.cache_size)
+            self.cache_tier = "disk"
         self._pool = ThreadPoolExecutor(max_workers=self.conf.max_upload, thread_name_prefix="upload")
         # per-read block fan-out (reference reader.go:160 async slice
         # workers; VERDICT r2 #7 — reads were serial per block)
@@ -90,6 +134,7 @@ class CachedStore:
         # content indexer (chunk/indexer.py), attached by cmd.build_store
         # when the volume has a hash_backend
         self.indexer = None
+        _LIVE_STORES.add(self)
         if self.conf.writeback:
             self._recover_staging()
 
@@ -103,25 +148,48 @@ class CachedStore:
                 raise
             except Exception as e:
                 last = e
+                if attempt + 1 < self.conf.max_retries:
+                    # count only attempts that WILL be retried; the terminal
+                    # failure raises and is an error, not a retry
+                    _RETRIES.labels(op.split(" ", 1)[0]).inc()
                 sleep = min(0.01 * (attempt + 1) ** 2, 3.0)  # quadratic backoff
                 logger.warning("%s failed (try %d): %s", op, attempt + 1, e)
                 time.sleep(sleep)
         raise last  # type: ignore[misc]
 
-    def _put_block(self, key: str, raw: bytes) -> None:
+    def _put_block(self, key: str, raw: bytes, parent=None) -> None:
         """Compress (+fingerprint) and PUT one block
-        (reference cached_store.go:371-413 upload)."""
-        if self.conf.fingerprint is not None:
-            self.conf.fingerprint(key, raw)
-        data = self.compressor.compress(raw)
-        self._with_retry(f"PUT {key}", lambda: self.storage.put(key, data))
+        (reference cached_store.go:371-413 upload). `parent` is the span
+        ref captured before the upload-pool crossing."""
+        with _TR.span("chunk", "upload", stage="put", hist=_H_UPLOAD,
+                      parent=parent) as sp:
+            if sp.active:
+                sp.set(key=key, bytes=len(raw))
+            if self.conf.fingerprint is not None:
+                self.conf.fingerprint(key, raw)
+            data = self.compressor.compress(raw)
+            self._with_retry(f"PUT {key}", lambda: self.storage.put(key, data))
 
-    def _load_block(self, key: str, bsize: int, cache_after: bool = True) -> bytes:
+    def _note_cache_hit(self, key: str, bsize: int) -> None:
+        """Prefetch effectiveness: credit the prefetcher when a hit
+        consumed a block it warmed."""
+        self._fetcher.consumed((key, bsize))
+
+    def _count_miss(self) -> None:
+        """Record a block-cache miss on a path that bypasses _load_block
+        (the ranged-GET shortcut fetches without an authoritative probe)."""
+        from .mem_cache import _MISS
+
+        _MISS.labels(self.cache_tier).inc()
+
+    def _load_block(self, key: str, bsize: int, cache_after: bool = True,
+                    parent=None) -> bytes:
         """Singleflight full-block load (reference cached_store.go:673-749)."""
 
         def do() -> bytes:
             cached = self.cache.load(key)
             if cached is not None:
+                self._note_cache_hit(key, bsize)
                 return cached
             with self._pending_lock:
                 staged = self._pending_staged.get(key)
@@ -139,20 +207,28 @@ class CachedStore:
                     )
                 return raw
 
-            raw = self._with_retry(f"GET {key}", fetch)
+            with _TR.span("chunk", "load", stage="fetch", hist=_H_FETCH,
+                          parent=parent) as sp:
+                if sp.active:
+                    sp.set(key=key, bytes=bsize)
+                raw = self._with_retry(f"GET {key}", fetch)
             if cache_after:
                 self.cache.cache(key, raw)
             return raw
 
         return self._group.do(key, do)
 
-    def _prefetch_block(self, key_size) -> None:
+    def _prefetch_block(self, key_size) -> bool:
+        """Returns True only when this call actually warmed the block
+        (Prefetcher credits juicefs_prefetch_used from that)."""
         key, bsize = key_size
-        if self.cache.load(key) is None:
+        if self.cache.load(key, count_miss=False) is None:
             try:
                 self._load_block(key, bsize)
+                return True
             except NotFoundError:
                 pass
+        return False
 
     # -- public API (reference chunk.go:37-46 ChunkStore) ------------------
     def _block_range(self, sid: int, length: int, off: int = 0, size: int | None = None):
@@ -204,7 +280,7 @@ class CachedStore:
             return 0
         return sum(
             1 for key, _ in self._block_range(sid, length)
-            if self.cache.load(key) is not None
+            if self.cache.load(key, count_miss=False) is not None
         )
 
     def evict_cache(self, sid: int, length: int) -> None:
@@ -270,9 +346,9 @@ class CachedStore:
                 self._pending_staged[key] = raw
             self._pool.submit(self._upload_staged, key, raw)
 
-    def _upload_staged(self, key: str, raw: bytes) -> None:
+    def _upload_staged(self, key: str, raw: bytes, parent=None) -> None:
         try:
-            self._put_block(key, raw)
+            self._put_block(key, raw, parent)
             self.cache.uploaded(key, len(raw))
         finally:
             with self._pending_lock:
@@ -335,18 +411,24 @@ class WSlice:
             raw += b"\x00" * (bsize - len(raw))
         self._uploaded.add(indx)
         key = block_key(self.id, indx, bsize)
+        ref = _TR.current_ref()  # link pool-side upload spans to this write
         if self.store.conf.writeback:
             # stage to disk, ack immediately, upload in background
             # (reference cached_store.go:415-472 writeback branch)
-            path = self.store.cache.stage(key, raw)
+            with _TR.span("chunk", "upload", stage="stage", hist=_H_STAGE) as sp:
+                if sp.active:
+                    sp.set(key=key, bytes=len(raw))
+                path = self.store.cache.stage(key, raw)
             with self.store._pending_lock:
                 self.store._pending_staged[key] = raw
             if path is not None:
-                self.store._pool.submit(self.store._upload_staged, key, raw)
+                self.store._pool.submit(self.store._upload_staged, key, raw, ref)
             else:  # staging failed: fall back to sync-ish upload
-                self._futures.append(self.store._pool.submit(self.store._upload_staged, key, raw))
+                self._futures.append(
+                    self.store._pool.submit(self.store._upload_staged, key, raw, ref)
+                )
         else:
-            fut = self.store._pool.submit(self.store._put_block, key, raw)
+            fut = self.store._pool.submit(self.store._put_block, key, raw, ref)
             fut.add_done_callback(
                 lambda f, k=key, r=raw: self.store.cache.cache(k, r) if not f.exception() else None
             )
@@ -393,13 +475,19 @@ class RSlice:
     def _block_size(self, indx: int) -> int:
         return min(self.bs, self.length - indx * self.bs)
 
-    def read(self, off: int, size: int) -> bytes:
+    def read(self, off: int, size: int, parent=None) -> bytes:
         """Ranged read within the slice (reference ReadAt:96-204).
 
         Multi-block spans fan the missed block loads out over the store's
         download pool and assemble in order (reference reader.go:160 async
-        slice workers); singleflight dedups overlapping fetches.
+        slice workers); singleflight dedups overlapping fetches. `parent`
+        carries the span ref across the vfs slice fan-out pool.
         """
+        with _TR.span("chunk", "read", hist=_H_READ, parent=parent) as sp:
+            out = self._read(off, size, sp)
+        return out
+
+    def _read(self, off: int, size: int, sp) -> bytes:
         if off >= self.length or size <= 0:
             return b""
         size = min(size, self.length - off)
@@ -407,9 +495,19 @@ class RSlice:
         if boff + size <= self._block_size(indx):
             # fast path: one block, cache hit — return a zero-copy view
             # into the cached buffer (blocks are immutable once stored)
-            cached = self.store.cache.load(block_key(self.id, indx, self._block_size(indx)))
+            bsize = self._block_size(indx)
+            key = block_key(self.id, indx, bsize)
+            # speculative probe: a miss here falls through to _load_block,
+            # which re-probes and counts the miss exactly once
+            cached = self.store.cache.load(key, count_miss=False)
             if cached is not None:
+                self.store._note_cache_hit(key, bsize)
+                if sp.active:
+                    sp.set(sid=self.id, bytes=size,
+                           tier=self.store.cache_tier)
                 return memoryview(cached)[boff : boff + size]
+        if sp.active:
+            sp.set(sid=self.id, bytes=size)
         # plan the block segments covering [off, off+size)
         segs: list[tuple[int, int, int, int]] = []  # (indx, bsize, boff, n)
         pos = off
@@ -426,15 +524,18 @@ class RSlice:
         warm: dict[int, bytes] = {}
         if len(segs) > 1:
             # dispatch every uncached block load up front, in parallel
-            # (keeping probe hits so warm blocks are read exactly once)
+            # (keeping probe hits so warm blocks are read exactly once);
+            # the span ref crosses the download pool explicitly
+            ref = _TR.current_ref()
             for indx, bsize, _boff, _n in segs:
                 key = block_key(self.id, indx, bsize)
-                cached = self.store.cache.load(key)
+                cached = self.store.cache.load(key, count_miss=False)
                 if cached is not None:
+                    self.store._note_cache_hit(key, bsize)
                     warm[indx] = cached
                 else:
                     loads[indx] = self.store._rpool.submit(
-                        self.store._load_block, key, bsize
+                        self.store._load_block, key, bsize, True, ref
                     )
             if loads:
                 # sequential readahead: warm the block after the last
@@ -452,9 +553,9 @@ class RSlice:
                 out += fut.result()[boff : boff + n]
                 continue
             key = block_key(self.id, indx, bsize)
+            # single-segment reads already probed the cache on the fast
+            # path above, so a miss here is definitive — no re-probe
             cached = warm.get(indx)
-            if cached is None and len(segs) == 1:
-                cached = self.store.cache.load(key)
             if cached is not None:
                 out += cached[boff : boff + n]
             else:
@@ -466,6 +567,9 @@ class RSlice:
                     if staged is not None:
                         out += staged[boff : boff + n]
                     else:
+                        # this shortcut skips _load_block, so the miss the
+                        # speculative probe above suppressed lands here
+                        self.store._count_miss()
                         def ranged(k=key, o=boff, ln=n) -> bytes:
                             data = self.store.storage.get(k, o, ln)
                             if len(data) != ln:

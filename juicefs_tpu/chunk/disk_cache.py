@@ -24,8 +24,14 @@ import zlib
 from typing import Optional
 
 from ..utils import get_logger
+from .mem_cache import _EVICT, _EVICT_BYTES, _HITS, _MISS
 
 logger = get_logger("chunk.cache")
+
+_HITS_DISK = _HITS.labels("disk")
+_MISS_DISK = _MISS.labels("disk")
+_EVICT_DISK = _EVICT.labels("disk")
+_EVICT_BYTES_DISK = _EVICT_BYTES.labels("disk")
 
 _TRAILER = struct.Struct("<4sI")  # magic + crc32 of the payload
 _MAGIC = b"JFC1"
@@ -144,7 +150,9 @@ class DiskCache:
             return
         self._maybe_evict()
 
-    def load(self, key: str) -> Optional[bytes]:
+    def load(self, key: str, count_miss: bool = True) -> Optional[bytes]:
+        """count_miss semantics: see MemCache.load — speculative probes
+        pass False so each real miss is counted once."""
         path = self._raw_path(key)
         try:
             with open(path, "rb") as f:
@@ -153,8 +161,12 @@ class DiskCache:
             # also serve from staging (writeback block not yet uploaded)
             try:
                 with open(self._stage_path(key), "rb") as f:
-                    return f.read()
+                    data = f.read()
+                _HITS_DISK.inc()
+                return data
             except OSError:
+                if count_miss:
+                    _MISS_DISK.inc()
                 return None
         if self.checksum:
             if len(data) >= _TRAILER.size:
@@ -163,10 +175,14 @@ class DiskCache:
                 magic = b""
             if magic != _MAGIC:
                 self._drop_corrupt(key, "missing checksum trailer")
+                if count_miss:
+                    _MISS_DISK.inc()
                 return None
             data = data[: len(data) - _TRAILER.size]
             if zlib.crc32(data) != crc:
                 self._drop_corrupt(key, "crc mismatch (bitrot?)")
+                if count_miss:
+                    _MISS_DISK.inc()
                 return None
         with self._lock:
             item = self._index.get(key)
@@ -174,6 +190,7 @@ class DiskCache:
                 # refresh atime only; the recorded size stays the on-disk
                 # size so accounting doesn't drift from real usage
                 self._index[key] = (item[0], time.time())
+        _HITS_DISK.inc()
         return data
 
     def _drop_corrupt(self, key: str, why: str) -> None:
@@ -210,6 +227,8 @@ class DiskCache:
                 item = self._index.pop(key, None)
                 if item is not None:
                     self._used -= item[0]
+                    _EVICT_DISK.inc()
+                    _EVICT_BYTES_DISK.inc(item[0])
         for key in doomed:
             try:
                 os.unlink(self._raw_path(key))
@@ -331,8 +350,8 @@ class CacheManager:
     def cache(self, key, data):
         self._pick(key).cache(key, data)
 
-    def load(self, key):
-        return self._pick(key).load(key)
+    def load(self, key, count_miss: bool = True):
+        return self._pick(key).load(key, count_miss)
 
     def remove(self, key):
         self._pick(key).remove(key)

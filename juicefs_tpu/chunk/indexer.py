@@ -33,10 +33,37 @@ import threading
 import time
 from typing import Optional
 
+import weakref
+
+from ..metric import global_registry
+from ..metric.trace import global_tracer, stage_hist
 from ..utils import get_logger
 from .cached_store import parse_block_key
 
 logger = get_logger("chunk.indexer")
+
+_TR = global_tracer()
+_H_BATCH = stage_hist("tpu", "index", "batch")
+
+# queue-depth gauge aggregates over live indexers via weak refs (a gauge
+# closure must neither pin a discarded indexer nor report only the newest)
+_LIVE_INDEXERS: "weakref.WeakSet[BlockIndexer]" = weakref.WeakSet()
+
+
+def _queued_blocks() -> int:
+    total = 0
+    try:
+        for ix in list(_LIVE_INDEXERS):
+            total += ix._q.qsize()
+    except Exception:
+        pass
+    return total
+
+
+global_registry().gauge(
+    "juicefs_index_queue_blocks",
+    "Blocks queued for content-index hashing",
+).set_function(_queued_blocks)
 
 _STOP = object()
 
@@ -81,6 +108,7 @@ class BlockIndexer:
         self.busy_seconds = 0.0
         self.errors = 0
         self.dropped = 0  # blocks skipped under overload (gc backfills)
+        _LIVE_INDEXERS.add(self)
         self._thread = threading.Thread(
             target=self._loop, name="block-indexer", daemon=True
         )
@@ -96,6 +124,11 @@ class BlockIndexer:
         self.submit_raw(sid, indx, len(raw), bytes(raw))
 
     def submit_raw(self, sid: int, indx: int, bsize: int, raw: bytes) -> None:
+        if _TR.active:
+            # instantaneous marker linking the upload span tree into the
+            # tpu layer (the batch itself hashes on the worker thread)
+            with _TR.span("tpu", "enqueue") as sp:
+                sp.set(sid=sid, indx=indx, bytes=bsize)
         with self._cond:
             self._pending += 1
         try:
@@ -140,7 +173,10 @@ class BlockIndexer:
             return
         t0 = time.perf_counter()
         try:
-            digests = self._pipe.hash_blocks([raw for _, _, _, raw in batch])
+            with _TR.span("tpu", "index", stage="batch", hist=_H_BATCH) as sp:
+                if sp.active:
+                    sp.set(blocks=len(batch), backend=self.backend)
+                digests = self._pipe.hash_blocks([raw for _, _, _, raw in batch])
             if self.meta is not None:
                 self.meta.set_block_digests(
                     [

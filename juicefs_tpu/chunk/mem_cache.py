@@ -7,6 +7,27 @@ import threading
 import time
 from typing import Optional
 
+from ..metric import global_registry
+
+_reg = global_registry()
+# shared across tiers; children pre-resolved per module (labels() locks)
+_HITS = _reg.counter(
+    "juicefs_blockcache_hits", "Block cache lookups served locally", ("tier",)
+)
+_MISS = _reg.counter(
+    "juicefs_blockcache_miss", "Block cache lookups that missed", ("tier",)
+)
+_EVICT = _reg.counter(
+    "juicefs_blockcache_evict", "Blocks evicted from the cache", ("tier",)
+)
+_EVICT_BYTES = _reg.counter(
+    "juicefs_blockcache_evict_bytes", "Bytes evicted from the cache", ("tier",)
+)
+_HITS_MEM = _HITS.labels("mem")
+_MISS_MEM = _MISS.labels("mem")
+_EVICT_MEM = _EVICT.labels("mem")
+_EVICT_BYTES_MEM = _EVICT_BYTES.labels("mem")
+
 
 class MemCache:
     def __init__(self, capacity: int = 256 << 20):
@@ -29,15 +50,23 @@ class MemCache:
                 victim = min(self._data, key=lambda k: self._data[k][1])
                 buf, _ = self._data.pop(victim)
                 self._used -= len(buf)
+                _EVICT_MEM.inc()
+                _EVICT_BYTES_MEM.inc(len(buf))
 
-    def load(self, key: str) -> Optional[bytes]:
+    def load(self, key: str, count_miss: bool = True) -> Optional[bytes]:
+        """count_miss=False marks a speculative probe whose miss will be
+        re-checked (and counted) by the authoritative load — so one real
+        miss increments the counter exactly once."""
         with self._lock:
             item = self._data.get(key)
             if item is None:
+                if count_miss:
+                    _MISS_MEM.inc()
                 return None
             data, _ = item
             self._data[key] = (data, time.time())
-            return data
+        _HITS_MEM.inc()
+        return data
 
     def remove(self, key: str) -> None:
         with self._lock:

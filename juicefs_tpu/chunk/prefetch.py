@@ -1,4 +1,10 @@
-"""Prefetcher: N workers warming upcoming blocks (reference: pkg/chunk/prefetch.go:21-66)."""
+"""Prefetcher: N workers warming upcoming blocks (reference: pkg/chunk/prefetch.go:21-66).
+
+Effectiveness accounting: every accepted fetch counts as *issued*; when a
+later cache hit consumes a block this prefetcher warmed (the store calls
+`consumed()` on its hit paths), it counts as *used*. issued-vs-used is the
+readahead efficiency signal (a low ratio means the window wastes GETs).
+"""
 
 from __future__ import annotations
 
@@ -6,12 +12,34 @@ import queue
 import threading
 from typing import Callable, Hashable
 
+from ..metric import global_registry
+from ..metric.trace import global_tracer, stage_hist
+
+_reg = global_registry()
+_ISSUED = _reg.counter(
+    "juicefs_prefetch_issued", "Prefetch requests accepted onto the queue"
+)
+_DUP = _reg.counter(
+    "juicefs_prefetch_duplicates", "Prefetch requests already pending (skipped)"
+)
+_DROPPED = _reg.counter(
+    "juicefs_prefetch_dropped", "Prefetch requests dropped on a full queue"
+)
+_USED = _reg.counter(
+    "juicefs_prefetch_used", "Prefetched blocks later served from cache"
+)
+_TR = global_tracer()
+_H_FETCH = stage_hist("chunk", "prefetch", "fetch")
+
+_WARMED_CAP = 4096  # bounded issued-block memory for used-accounting
+
 
 class Prefetcher:
     def __init__(self, fetch: Callable[[Hashable], None], workers: int = 2, depth: int = 64):
         self._fetch = fetch
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._pending: set[Hashable] = set()
+        self._warmed: dict[Hashable, None] = {}  # insertion-ordered FIFO
         self._lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._run, daemon=True, name=f"prefetch-{i}")
@@ -23,19 +51,44 @@ class Prefetcher:
     def fetch(self, key: Hashable) -> None:
         with self._lock:
             if key in self._pending:
+                _DUP.inc()
                 return
             self._pending.add(key)
         try:
             self._q.put_nowait(key)
+            _ISSUED.inc()
         except queue.Full:
+            _DROPPED.inc()
             with self._lock:
                 self._pending.discard(key)
+
+    def consumed(self, key: Hashable) -> None:
+        """A cache hit consumed this block; count it as prefetch-used if
+        this prefetcher warmed it (pops so each warm counts once)."""
+        if not self._warmed:  # unlocked fast-out: hot hit path, no
+            return            # prefetch outstanding (races only under-count)
+        with self._lock:
+            if self._warmed.pop(key, 0) is None:
+                _USED.inc()
 
     def _run(self) -> None:
         while True:
             key = self._q.get()
             try:
-                self._fetch(key)
+                with _TR.span("chunk", "prefetch", stage="fetch",
+                              hist=_H_FETCH) as sp:
+                    if sp.active:
+                        sp.set(key=str(key))
+                    did = self._fetch(key)
+                # only fetches that actually warmed something earn used-
+                # credit: a truthy return from the fetch callable; no-ops
+                # (already cached, object missing) must not inflate
+                # juicefs_prefetch_used
+                if did:
+                    with self._lock:
+                        self._warmed[key] = None
+                        while len(self._warmed) > _WARMED_CAP:
+                            self._warmed.pop(next(iter(self._warmed)))
             except Exception:
                 pass
             finally:

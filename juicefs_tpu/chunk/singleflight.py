@@ -6,6 +6,17 @@ from __future__ import annotations
 import threading
 from typing import Callable, Hashable
 
+from ..metric import global_registry
+
+_reg = global_registry()
+_CALLS = _reg.counter(
+    "juicefs_singleflight_calls", "Singleflight fetches executed (leaders)"
+)
+_SHARED = _reg.counter(
+    "juicefs_singleflight_shared",
+    "Concurrent fetches deduplicated onto an in-flight leader",
+)
+
 
 class _Call:
     __slots__ = ("done", "result", "error")
@@ -31,10 +42,12 @@ class SingleFlight:
                 self._calls[key] = call
                 leader = True
         if not leader:
+            _SHARED.inc()
             call.done.wait()
             if call.error is not None:
                 raise call.error
             return call.result
+        _CALLS.inc()
         try:
             call.result = fn()
             return call.result

@@ -25,12 +25,19 @@ logger = get_logger("cmd.stats")
 def add_parser(sub):
     s = sub.add_parser("stats", help="show metrics of a mounted volume")
     s.add_argument("mountpoint")
-    s.add_argument("--filter", default="", help="metric name substring")
+    s.add_argument("--filter", default="",
+                   help="regular expression matched against metric lines "
+                        "(reference --filter semantics); lines without a "
+                        "match are hidden")
     s.set_defaults(func=run_stats)
 
     p = sub.add_parser("profile", help="aggregate live op latencies from a mount")
     p.add_argument("mountpoint")
     p.add_argument("--duration", type=float, default=2.0, help="seconds to sample")
+    p.add_argument("--trace", default="", metavar="DIR",
+                   help="sample span events from the mount's .trace stream "
+                        "instead of .accesslog and write a chrome://tracing-"
+                        "loadable trace_event JSON into DIR")
     p.set_defaults(func=run_profile)
 
     d = sub.add_parser("debug", help="collect diagnostics from a mount")
@@ -57,10 +64,17 @@ def add_parser(sub):
 
 
 def run_stats(args) -> int:
+    pat = None
+    if args.filter:
+        try:
+            pat = re.compile(args.filter)
+        except re.error as e:
+            print(f"stats: invalid --filter regex {args.filter!r}: {e}")
+            return 1
     with open(os.path.join(args.mountpoint, ".stats"), "rb") as f:
         text = f.read().decode()
     for line in text.splitlines():
-        if args.filter and args.filter not in line:
+        if pat is not None and not pat.search(line):
             continue
         if line and not line.startswith("#"):
             print(line)
@@ -70,16 +84,108 @@ def run_stats(args) -> int:
 _LOG_RE = re.compile(r"\[uid:\d+,gid:\d+,pid:\d+\] (\w+) \(.*\): (\S+).* <([0-9.]+)>")
 
 
+def open_stream(path: str) -> int:
+    """Open a live virtual stream (.accesslog / .trace) uncached.
+
+    O_DIRECT first: kernels that ignore the server's FOPEN_DIRECT_IO
+    (gVisor-style FUSE) would otherwise serve a stream through the page
+    cache, replaying stale pages instead of fresh lines. FUSE imposes no
+    O_DIRECT alignment constraints; fall back to a plain open where
+    O_DIRECT is unsupported."""
+    try:
+        return os.open(path, os.O_RDONLY | getattr(os, "O_DIRECT", 0))
+    except OSError:
+        return os.open(path, os.O_RDONLY)
+
+
+# event keys that are structure, not user attrs, when converting to the
+# Chrome trace_event format
+_SPAN_FIELDS = ("ts", "dur", "trace", "id", "parent", "layer", "op", "stage")
+
+
+def _chrome_event(ev: dict) -> dict:
+    """One .trace span event -> one Chrome trace_event 'X' entry
+    (loadable in chrome://tracing and Perfetto)."""
+    name = str(ev.get("op", "?"))
+    if ev.get("stage"):
+        name += ":" + str(ev["stage"])
+    args = {k: v for k, v in ev.items() if k not in _SPAN_FIELDS}
+    args["span_id"] = ev.get("id", 0)
+    args["parent_id"] = ev.get("parent", 0)
+    return {
+        "name": name,
+        "cat": str(ev.get("layer", "?")),
+        "ph": "X",
+        "ts": float(ev.get("ts", 0.0)) * 1e6,
+        "dur": max(float(ev.get("dur", 0.0)) * 1e6, 0.1),
+        "pid": 1,
+        "tid": int(ev.get("trace", 0)),
+        "args": args,
+    }
+
+
+def run_trace_profile(args) -> int:
+    """`profile --trace DIR`: sample the mount's .trace span stream for
+    --duration seconds and write a chrome://tracing JSON into DIR."""
+    events: list[dict] = []
+    deadline = time.time() + args.duration
+    buf = b""
+    fd = open_stream(os.path.join(args.mountpoint, ".trace"))
+    try:
+        while time.time() < deadline:
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                # EOF (size-clamping kernel exhausted STREAM_LENGTH, or
+                # unmounted): don't spin hot on instant empty reads
+                time.sleep(0.05)
+                continue
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict):
+                    events.append(ev)
+    finally:
+        os.close(fd)
+    os.makedirs(args.trace, exist_ok=True)
+    path = os.path.join(args.trace, "juicefs-trace.json")
+    with open(path, "w") as out:
+        json.dump(
+            {
+                "traceEvents": [_chrome_event(ev) for ev in events],
+                "displayTimeUnit": "ms",
+            },
+            out,
+        )
+    per_layer: dict[str, int] = defaultdict(int)
+    for ev in events:
+        per_layer[str(ev.get("layer", "?"))] += 1
+    summary = ", ".join(f"{k}:{v}" for k, v in sorted(per_layer.items()))
+    print(f"sampled {len(events)} spans ({summary or 'none'}) -> {path}")
+    return 0
+
+
 def run_profile(args) -> int:
+    if getattr(args, "trace", ""):
+        return run_trace_profile(args)
     stats: dict[str, list[float]] = defaultdict(list)
     deadline = time.time() + args.duration
-    with open(os.path.join(args.mountpoint, ".accesslog"), "rb") as f:
+    fd = open_stream(os.path.join(args.mountpoint, ".accesslog"))
+    try:
         while time.time() < deadline:
-            chunk = f.read(1 << 16)
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                time.sleep(0.05)  # EOF: see run_trace_profile
+                continue
             for line in chunk.decode(errors="replace").splitlines():
                 m = _LOG_RE.search(line)
                 if m:
                     stats[m.group(1)].append(float(m.group(3)))
+    finally:
+        os.close(fd)
     print(f"{'op':<16}{'count':>8}{'avg_ms':>10}{'total_ms':>10}")
     for op, durs in sorted(stats.items(), key=lambda kv: -sum(kv[1])):
         total = sum(durs)

@@ -64,9 +64,7 @@ def mount(
     opts.append("ro" if readonly else "rw")
     if allow_other:
         opts.append("allow_other")
-    fd = fusermount(mountpoint, ",".join(opts))
-    tune_readahead(mountpoint)
-    return fd
+    return fusermount(mountpoint, ",".join(opts))
 
 
 def tune_readahead(mountpoint: str, kb: int = 1024) -> None:
@@ -75,7 +73,13 @@ def tune_readahead(mountpoint: str, kb: int = 1024) -> None:
     per-request round trip, not bandwidth, bounds a userspace server
     (measured 374 -> 1042 MiB/s big-read on this env). Best-effort:
     needs root or CAP_SYS_ADMIN-ish write access to sysfs; the reference
-    documents the same sysctl tuning for its mounts."""
+    documents the same sysctl tuning for its mounts.
+
+    Must run only once the request loop is serving: the os.stat here is a
+    FUSE GETATTR on the fresh mount, and some kernels answer it from the
+    daemon rather than the mount record — calling this before serve()
+    deadlocks the mount (observed on 4.4-era kernels). Server.serve()
+    fires it from a helper thread once the workers are pulling requests."""
     try:
         st = os.stat(mountpoint)
         path = (f"/sys/class/bdi/{os.major(st.st_dev)}:"

@@ -18,12 +18,20 @@ import threading
 import time
 from ..meta.context import Context
 from ..meta.types import Attr, type_to_stat_mode
+from ..metric.trace import global_tracer
 from ..utils import get_logger
+from ..vfs.internal import is_internal as _is_internal_ino
 from ..vfs.vfs import VFS
 from . import kernel as k
-from .mount import mount as _mount, umount as _umount
+from .mount import (
+    mount as _mount,
+    tune_readahead as _tune_readahead,
+    umount as _umount,
+)
 
 logger = get_logger("fuse.server")
+
+_TR = global_tracer()
 
 MAX_WRITE = 1 << 20
 BLKSIZE = 65536
@@ -162,6 +170,12 @@ class Server:
         ]
         for t in extra:
             t.start()
+        # best-effort bdi tuning AFTER workers are pulling requests: its
+        # os.stat is itself a FUSE op on this mount (see tune_readahead)
+        threading.Thread(
+            target=_tune_readahead, args=(self.mountpoint,), daemon=True,
+            name="fuse-tune",
+        ).start()
         self._serve_loop()
         for t in extra:
             t.join(timeout=5.0)
@@ -329,14 +343,39 @@ class Server:
             body = req[k.IN_HEADER_SIZE:length]
         ctx = Context(uid=uid, gid=gid, gids=(gid,), pid=pid)
         handler = self._handlers.get(opcode)
+        # Request root span (the fuse entry point of every trace tree).
+        # Internal virtual inodes are never traced: a READ of `.trace`
+        # would feed the very stream being read. Zero-cost when no
+        # consumer holds `.trace` open (span() returns the shared no-op).
+        if (
+            _TR.active
+            and handler is not None
+            and not _is_internal_ino(nodeid)
+            and opcode not in (k.FORGET, k.BATCH_FORGET, k.INTERRUPT,
+                               k.INIT, k.DESTROY)
+        ):
+            sp = _TR.span(
+                "fuse", k.OPCODE_NAMES.get(opcode, str(opcode)).lower(),
+                ino=nodeid, pid=pid, uid=uid,
+            )
+        else:
+            sp = None
         try:
-            if handler is None:
-                out: object = _errno.ENOSYS
-            else:
-                out = handler(ctx, (unique, nodeid), body)
-        except Exception:
-            logger.exception("op %s", k.OPCODE_NAMES.get(opcode, opcode))
-            out = _errno.EIO
+            if sp is not None:
+                sp.__enter__()
+            try:
+                if handler is None:
+                    out: object = _errno.ENOSYS
+                else:
+                    out = handler(ctx, (unique, nodeid), body)
+            except Exception:
+                logger.exception("op %s", k.OPCODE_NAMES.get(opcode, opcode))
+                out = _errno.EIO
+            if sp is not None and isinstance(out, int):
+                sp.set(errno=out)
+        finally:
+            if sp is not None:
+                sp.__exit__(None, None, None)
         if out is None or out is ASYNC:  # FORGET has no reply; ASYNC replies later
             return
         self._reply(unique, out)

@@ -11,11 +11,38 @@ from __future__ import annotations
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..metric.trace import global_tracer
+
+_TR = global_tracer()
+
 
 class BaseHandler(BaseHTTPRequestHandler):
     """Common helpers for the S3 and WebDAV handlers."""
 
     protocol_version = "HTTP/1.1"
+
+    def parse_request(self):
+        """Open the gateway root span only once a request line has been
+        parsed — the keep-alive idle wait before it must not be timed,
+        and a client disconnect must not emit a phantom span."""
+        ok = super().parse_request()
+        if ok and _TR.active:
+            self._gw_span = _TR.span(
+                "gateway", (self.command or "request").lower(),
+                path=self.path, adapter=type(self).__name__,
+            )
+            self._gw_span.__enter__()
+        return ok
+
+    def handle_one_request(self):
+        self._gw_span = None
+        try:
+            super().handle_one_request()
+        finally:
+            sp = self._gw_span
+            self._gw_span = None
+            if sp is not None:
+                sp.__exit__(None, None, None)
 
     def _body(self) -> bytes:
         n = int(self.headers.get("Content-Length", 0) or 0)

@@ -179,14 +179,30 @@ class Registry:
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
         self.common_labels = common_labels or {}
+        # conflicting re-registrations (same name, different type/labels):
+        # recorded instead of raising — the first registration wins at
+        # runtime, and tools/lint_metrics.py fails CI on any entry here
+        self.conflicts: list[str] = []
 
     def _register(self, metric: _Metric) -> _Metric:
         with self._lock:
             existing = self._metrics.get(metric.name)
             if existing is not None:
+                if (existing.kind != metric.kind
+                        or existing.label_names != metric.label_names):
+                    self.conflicts.append(
+                        f"{metric.name}: re-registered as {metric.kind}"
+                        f"{metric.label_names} (was {existing.kind}"
+                        f"{existing.label_names})"
+                    )
                 return existing
             self._metrics[metric.name] = metric
             return metric
+
+    def walk(self) -> list[_Metric]:
+        """Snapshot of registered metrics (lint / snapshot consumers)."""
+        with self._lock:
+            return list(self._metrics.values())
 
     def counter(self, name: str, help_: str = "", labels: tuple[str, ...] = ()) -> Counter:
         return self._register(Counter(name, help_, labels))  # type: ignore[return-value]

@@ -16,6 +16,7 @@ from typing import Callable
 from .interface import Obj, ObjectStorage, NotFoundError
 from .file import FileStorage
 from .mem import MemStorage
+from .metered import MeteredStorage, metered
 from .prefix import with_prefix
 from .sharding import sharded
 from .checksum import new_checksummed, crc32c
@@ -100,6 +101,8 @@ __all__ = [
     "MemStorage",
     "create_storage",
     "register",
+    "metered",
+    "MeteredStorage",
     "with_prefix",
     "sharded",
     "new_checksummed",

@@ -16,14 +16,30 @@ import os
 import struct
 from typing import Iterator
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # gated: encrypted volumes error lazily, everything
+    # else (the whole object package imports this module) keeps working
+    HAVE_CRYPTOGRAPHY = False
+    hashes = serialization = padding = rsa = AESGCM = None
 
 from .interface import Obj, ObjectStorage
 
 
+def _require_cryptography() -> None:
+    if not HAVE_CRYPTOGRAPHY:
+        raise RuntimeError(
+            "the 'cryptography' package is not installed; encrypted "
+            "volumes are unavailable in this environment"
+        )
+
+
 def generate_rsa_key_pem(bits: int = 2048, password: bytes | None = None) -> bytes:
+    _require_cryptography()
     key = rsa.generate_private_key(public_exponent=65537, key_size=bits)
     enc = (
         serialization.BestAvailableEncryption(password)
@@ -40,6 +56,7 @@ class RSAEncryptor:
 
     def __init__(self, pem: bytes, password: bytes | None = None,
                  key=None):
+        _require_cryptography()
         self._key = key if key is not None else \
             serialization.load_pem_private_key(pem, password)
         self._pad = padding.OAEP(
@@ -135,6 +152,7 @@ class ECIESEncryptor:
 
     def __init__(self, pem: bytes, password: bytes | None = None,
                  key=None):
+        _require_cryptography()
         from cryptography.hazmat.primitives.asymmetric import ec
 
         self._key = key if key is not None else \
@@ -183,6 +201,7 @@ class ECIESEncryptor:
 
 
 def generate_ec_key_pem(password: bytes | None = None) -> bytes:
+    _require_cryptography()
     from cryptography.hazmat.primitives.asymmetric import ec
 
     key = ec.generate_private_key(ec.SECP256R1())
@@ -237,6 +256,7 @@ def _key_encryptor(pem: bytes, password: bytes | None):
     """RSA or EC PEM -> the matching key encryptor (reference
     encrypt.go:66-123 parses both). One parse: the loaded key object is
     handed to the encryptor (an encrypted PEM's KDF is not cheap)."""
+    _require_cryptography()
     key = serialization.load_pem_private_key(pem, password)
     from cryptography.hazmat.primitives.asymmetric import ec
 

@@ -7,7 +7,12 @@ import heapq
 import zlib
 from typing import Iterator
 
+from ..metric import global_registry
 from .interface import Obj, ObjectStorage
+
+_SHARD_OPS = global_registry().counter(
+    "juicefs_object_shard_ops", "Object ops routed to each shard", ("shard",)
+)
 
 
 class _Sharded(ObjectStorage):
@@ -15,11 +20,14 @@ class _Sharded(ObjectStorage):
         if not stores:
             raise ValueError("sharded: need at least one store")
         self._stores = stores
+        self._shard_ops = [_SHARD_OPS.labels(str(i)) for i in range(len(stores))]
 
     def _pick(self, key: str) -> ObjectStorage:
         # stable fnv-ish hash by key, like the reference's hash-by-name
         h = zlib.crc32(key.encode()) & 0xFFFFFFFF
-        return self._stores[h % len(self._stores)]
+        i = h % len(self._stores)
+        self._shard_ops[i].inc()
+        return self._stores[i]
 
     def string(self) -> str:
         return f"shard{len(self._stores)}://[{self._stores[0].string()}...]"

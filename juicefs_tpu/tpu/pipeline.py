@@ -18,7 +18,28 @@ from typing import Iterable, Iterator, Optional
 
 import numpy as np
 
+from ..metric import global_registry
+from ..metric.trace import global_tracer, stage_hist
 from .jth256 import BLOCK_BYTES, LANE_BYTES, digests_to_bytes, pack_blocks
+
+_reg = global_registry()
+_BLOCKS_HASHED = _reg.counter(
+    "juicefs_tpu_blocks_hashed", "Blocks hashed by the TPU pipeline"
+)
+_HASH_BYTES = _reg.counter(
+    "juicefs_tpu_hash_bytes", "Raw bytes hashed by the TPU pipeline"
+)
+_H2D_BYTES = _reg.counter(
+    "juicefs_tpu_h2d_bytes",
+    "Host-to-device bytes shipped as packed hash batches",
+)
+_BATCH_BLOCKS = _reg.histogram(
+    "juicefs_tpu_batch_blocks", "Blocks per dispatched hash batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
+_TR = global_tracer()
+_H_DISPATCH = stage_hist("tpu", "hash", "dispatch")
+_H_DRAIN = stage_hist("tpu", "hash", "drain")
 
 
 @dataclass
@@ -66,20 +87,41 @@ class HashPipeline:
             nonlocal keys, blocks
             if not blocks:
                 return
-            if self._fn is None:
-                # CPU path: hash raw bytes directly (native C++ batch with
-                # numpy fallback) — no packing cost, already synchronous.
-                from .. import native
+            nbytes = sum(len(b) for b in blocks)
+            with _TR.span("tpu", "hash", stage="dispatch",
+                          hist=_H_DISPATCH) as sp:
+                if sp.active:
+                    sp.set(batch=len(blocks), bytes=nbytes,
+                           backend=self.config.backend)
+                if self._fn is None:
+                    # CPU path: hash raw bytes directly (native C++ batch with
+                    # numpy fallback) — no packing cost, already synchronous,
+                    # and no device transfer (h2d counter stays untouched).
+                    from .. import native
 
-                pending.append((keys, native.jth256_batch(blocks)))
-            else:
-                words, counts, lengths = pack_blocks(blocks, pad_lanes=cfg.pad_lanes)
-                pending.append((keys, self._fn(words, counts, lengths)))
+                    pending.append((keys, native.jth256_batch(blocks)))
+                else:
+                    words, counts, lengths = pack_blocks(blocks, pad_lanes=cfg.pad_lanes)
+                    _H2D_BYTES.inc(words.nbytes)
+                    pending.append((keys, self._fn(words, counts, lengths)))
+            _BATCH_BLOCKS.observe(len(blocks))
+            _BLOCKS_HASHED.inc(len(blocks))
+            _HASH_BYTES.inc(nbytes)
             keys, blocks = [], []
 
         def drain(batch) -> Iterator[tuple[str, bytes]]:
             bkeys, out = batch
-            digests = out if isinstance(out, list) else digests_to_bytes(np.asarray(out))
+            if isinstance(out, list):
+                digests = out
+            else:
+                # blocking device sync: the stage where dispatch latency
+                # actually lands (JAX dispatch above is async)
+                with _TR.span("tpu", "hash", stage="drain",
+                              hist=_H_DRAIN) as sp:
+                    if sp.active:
+                        sp.set(batch=len(bkeys),
+                               backend=self.config.backend)
+                    digests = digests_to_bytes(np.asarray(out))
             return zip(bkeys, digests[: len(bkeys)])
 
         for key, data in items:

@@ -29,6 +29,21 @@ from .dedup import dedup_scan_jax
 from .hash_jax import _combine_accs, _lane_accs, _lane_states, _row_chain_scan
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the top-level alias (with
+    check_vma) only exists on newer releases; older ones ship it under
+    jax.experimental with the check_rep spelling."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:  # version window where the kwarg is still check_rep
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 def make_mesh(
     n_data: int | None = None, n_lane: int = 1, devices=None
 ) -> Mesh:
@@ -69,12 +84,11 @@ def sharded_scan_step(mesh: Mesh):
     def step(words, lane_counts, lengths):
         return _scan_body(words, lane_counts, lengths)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step,
         mesh=mesh,
         in_specs=(P("data", "lane", None, None), P("data"), P("data")),
         out_specs=(P(), P(), P()),
-        check_vma=False,
     )
     return jax.jit(mapped)
 
@@ -98,12 +112,11 @@ def sharded_scan_many(mesh: Mesh):
 
         return lax.fori_loop(jnp.uint32(0), iters, body, jnp.uint32(0))
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         many,
         mesh=mesh,
         in_specs=(P("data", "lane", None, None), P("data"), P("data"), P()),
         out_specs=P(),
-        check_vma=False,
     )
     return jax.jit(mapped)
 

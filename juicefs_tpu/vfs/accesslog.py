@@ -36,13 +36,16 @@ class AccessLogger:
     def active(self) -> bool:
         return self._active
 
-    def logit(self, op: str, args: str, err: int, dur: float, pid: int = 0) -> None:
+    def logit(self, op: str, args: str, err: int, dur: float, pid: int = 0,
+              uid: int = 0, gid: int = 0) -> None:
         if not self._active:
             return
         ts = time.time()
+        # real caller identity (reference accesslog.go logs the request's
+        # uid/gid/pid, not the server's); line format otherwise unchanged
         line = (
             f"{time.strftime('%Y.%m.%d %H:%M:%S', time.localtime(ts))}"
-            f".{int(ts % 1 * 1e6):06d} [uid:0,gid:0,pid:{pid}] "
+            f".{int(ts % 1 * 1e6):06d} [uid:{uid},gid:{gid},pid:{pid}] "
             f"{op} ({args}): {'OK' if err == 0 else f'errno {err}'} "
             f"<{dur:.6f}>\n"
         ).encode()

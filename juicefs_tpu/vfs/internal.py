@@ -1,6 +1,6 @@
 """Internal virtual files (reference pkg/vfs/internal.go:78-105).
 
-Four virtual inodes live at the volume root, invisible to readdir:
+Five virtual inodes live at the volume root, invisible to readdir:
 
   .control    write a JSON command, read back streamed JSON result
               (reference writes binary op+args and reads progress
@@ -8,6 +8,8 @@ Four virtual inodes live at the volume root, invisible to readdir:
               role, JSON encoding). Ops: info, summary, rmr, warmup,
               compact, clone.
   .accesslog  live op trace; lines materialize only while open
+  .trace      live span-event stream (JSON lines, metric/trace.py);
+              spans materialize only while open, like .accesslog
   .stats      point-in-time Prometheus text dump of the registry
   .config     the volume's runtime VFSConfig + Format as JSON
 
@@ -23,26 +25,40 @@ import time
 
 from ..meta.context import Context
 from ..meta.types import Attr, TYPE_FILE
+from ..metric.trace import global_tracer
 
 CONTROL_INO = 0x7FFFFFFF
 LOG_INO = 0x7FFFFFFE
 STATS_INO = 0x7FFFFFFD
 CONFIG_INO = 0x7FFFFFFC
-MIN_INTERNAL_INO = CONFIG_INO
+TRACE_INO = 0x7FFFFFFB
+MIN_INTERNAL_INO = TRACE_INO
 
 INTERNAL_NAMES = {
     b".control": CONTROL_INO,
     b".accesslog": LOG_INO,
     b".stats": STATS_INO,
     b".config": CONFIG_INO,
+    b".trace": TRACE_INO,
 }
+
+
+# Advertised length of the virtual files. The reference reports 0 and
+# relies on FOPEN_DIRECT_IO to keep the kernel reading past "EOF", but
+# some kernels (gVisor-style 4.4 emulation) ignore the flag and clamp
+# reads at i_size — making every virtual file read empty. A modest fake
+# length keeps both behaviors working: direct-io kernels ignore it,
+# clamping kernels keep issuing reads (a stream reader there gets at most
+# this many bytes per open). Kept small enough that a buffered read()
+# sizing its buffer from st_size stays cheap.
+STREAM_LENGTH = 4 << 20
 
 
 def internal_attr(ino: int) -> Attr:
     now = int(time.time())
     return Attr(
         typ=TYPE_FILE, mode=0o400 if ino != CONTROL_INO else 0o600,
-        uid=0, gid=0, nlink=1, length=0,
+        uid=0, gid=0, nlink=1, length=STREAM_LENGTH,
         atime=now, mtime=now, ctime=now, full=True,
     )
 
@@ -165,6 +181,10 @@ class InternalFiles:
     def open(self, ino: int, fh: int) -> None:
         if ino == LOG_INO:
             self.vfs.accesslog.open_reader(fh)
+        elif ino == TRACE_INO:
+            # the tracer is process-global: key the reader by this mount
+            # too, so two mounts' fh counters cannot collide
+            global_tracer().open_reader((id(self), fh))
         elif ino == STATS_INO:
             from ..metric import global_registry
 
@@ -184,6 +204,8 @@ class InternalFiles:
     def read(self, ino: int, fh: int, off: int, size: int) -> tuple[int, bytes]:
         if ino == LOG_INO:
             return 0, self.vfs.accesslog.read(fh, size)
+        if ino == TRACE_INO:
+            return 0, global_tracer().read((id(self), fh), size)
         buf = self._bufs.get(fh, b"")
         return 0, buf[off : off + size]
 
@@ -203,4 +225,6 @@ class InternalFiles:
     def release(self, ino: int, fh: int) -> None:
         if ino == LOG_INO:
             self.vfs.accesslog.close_reader(fh)
+        elif ino == TRACE_INO:
+            global_tracer().close_reader((id(self), fh))
         self._bufs.pop(fh, None)

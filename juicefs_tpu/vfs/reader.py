@@ -27,8 +27,11 @@ from ..meta.base import BaseMeta
 from ..meta.context import Context
 from ..meta.slice import build_slice
 from ..meta.types import CHUNK_SIZE
+from ..metric.trace import global_tracer
 
 DEFAULT_MAX_READAHEAD = 8 << 20
+
+_TR = global_tracer()
 
 
 class FileReader:
@@ -129,8 +132,9 @@ class FileReader:
             # A dedicated pool avoids nested-submit deadlock with the
             # store's block-level download pool, which RSlice.read may
             # itself use for multi-block spans.
+            ref = _TR.current_ref()  # span ref crosses the pool explicitly
             futs = [
-                (s0, self.dr.spool.submit(self._read_seg, seg, s0, s1))
+                (s0, self.dr.spool.submit(self._read_seg, seg, s0, s1, ref))
                 for s0, s1, seg in segs
             ]
             for s0, fut in futs:
@@ -142,9 +146,9 @@ class FileReader:
             out[s0 - coff : s0 - coff + len(data)] = data
         return 0, bytes(out)  # multi-seg/hole case: out was assembled here
 
-    def _read_seg(self, seg, s0: int, s1: int) -> bytes:
+    def _read_seg(self, seg, s0: int, s1: int, parent=None) -> bytes:
         rs = self.dr.store.new_reader(seg.id, seg.size)
-        return rs.read(seg.off + (s0 - seg.pos), s1 - s0)
+        return rs.read(seg.off + (s0 - seg.pos), s1 - s0, parent=parent)
 
     def _readahead(self, off: int, size: int) -> None:
         """Warm the blocks backing [off, off+size) via the prefetch pool."""

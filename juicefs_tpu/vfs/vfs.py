@@ -116,11 +116,16 @@ class VFS:
         self._instrument()
 
     def _instrument(self) -> None:
-        """Wrap public ops with latency metrics + access logging
-        (reference: every VFS method logit()s, accesslog.go:64)."""
+        """Wrap public ops with latency metrics + access logging + vfs-layer
+        spans (reference: every VFS method logit()s, accesslog.go:64). Ops
+        on the internal virtual files are never logged or traced — they
+        would feed the very stream being read."""
         import time as _time
 
+        from ..metric.trace import NULL_SPAN, global_tracer
+
         self._op_depth = threading.local()
+        tracer = global_tracer()
 
         for name in (
             "lookup", "getattr", "setattr", "mknod", "mkdir", "unlink",
@@ -129,36 +134,46 @@ class VFS:
             "truncate_ino", "copy_file_range", "statfs",
         ):
             orig = getattr(self, name)
+            op_hist = self._op_hist.labels(name)
 
-            def wrapper(ctx, *a, __orig=orig, __name=name, **kw):
+            def wrapper(ctx, *a, __orig=orig, __name=name, __hist=op_hist, **kw):
                 # Only the outermost op records: fsync->flush and
                 # O_APPEND-write->getattr are internal self-calls, not
                 # kernel requests (one log line per VFS op, like the
                 # reference).
                 if getattr(self._op_depth, "d", 0) > 0:
                     return __orig(ctx, *a, **kw)
+                internal = (
+                    bool(a) and isinstance(a[0], int) and is_internal(a[0])
+                )
+                sp = NULL_SPAN if internal else tracer.span("vfs", __name)
                 self._op_depth.d = 1
                 t0 = _time.perf_counter()
-                try:
-                    out = __orig(ctx, *a, **kw)
-                finally:
-                    self._op_depth.d = 0
-                dur = _time.perf_counter() - t0
-                self._op_hist.labels(__name).observe(dur)
-                if self.accesslog.active and not (
-                    a and isinstance(a[0], int) and is_internal(a[0])
-                ):
-                    # ops on the virtual files themselves are not logged
-                    # (they would feed the log they are reading)
+                with sp:
+                    try:
+                        out = __orig(ctx, *a, **kw)
+                    finally:
+                        self._op_depth.d = 0
+                        dur = _time.perf_counter() - t0
+                        __hist.observe(dur)
                     err = out[0] if isinstance(out, tuple) else out
                     if not isinstance(err, int):
                         err = 0
-                    args = ",".join(
-                        str(x) for x in a[:3] if isinstance(x, (int, bytes, str))
-                    )
-                    self.accesslog.logit(
-                        __name, args, err, dur, getattr(ctx, "pid", 0)
-                    )
+                    if sp.active:
+                        sp.set(
+                            ino=a[0] if a and isinstance(a[0], int) else 0,
+                            errno=err,
+                        )
+                    if self.accesslog.active and not internal:
+                        args = ",".join(
+                            str(x) for x in a[:3] if isinstance(x, (int, bytes, str))
+                        )
+                        self.accesslog.logit(
+                            __name, args, err, dur,
+                            pid=getattr(ctx, "pid", 0),
+                            uid=getattr(ctx, "uid", 0),
+                            gid=getattr(ctx, "gid", 0),
+                        )
                 return out
 
             setattr(self, name, wrapper)

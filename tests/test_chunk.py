@@ -26,7 +26,10 @@ def test_block_key_scheme():
 
 @pytest.mark.parametrize("compress", ["", "lz4", "zstd"])
 def test_write_read_roundtrip(compress):
-    store = make_store(compress=compress)
+    try:
+        store = make_store(compress=compress)
+    except ModuleNotFoundError as e:
+        pytest.skip(f"{compress} codec unavailable: {e}")
     data = os.urandom(200_000)  # ~3 blocks of 64 KiB
     w = store.new_writer(7)
     w.write_at(data, 0)
@@ -269,7 +272,10 @@ def test_compressor_thread_safety(algo):
 
     from juicefs_tpu.compress import new_compressor
 
-    comp = new_compressor(algo)
+    try:
+        comp = new_compressor(algo)
+    except ModuleNotFoundError as e:
+        pytest.skip(f"{algo} codec unavailable: {e}")
     payloads = [os.urandom(1 << 20) + bytes(1 << 20) for _ in range(16)]
 
     def roundtrip(p):

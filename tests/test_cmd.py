@@ -253,6 +253,7 @@ def test_fs_bench(tmp_path, capsys):
 
 
 def test_format_with_encryption_encrypts_at_rest(tmp_path, capsys):
+    pytest.importorskip("cryptography")
     from juicefs_tpu.object import generate_rsa_key_pem
 
     pem = tmp_path / "key.pem"

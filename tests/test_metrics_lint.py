@@ -1,0 +1,66 @@
+"""Registry lint gate (CI satellite): tools/lint_metrics.py must pass on
+the real registry, and must actually catch the defect classes it claims."""
+
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics", os.path.join(_ROOT, "tools", "lint_metrics.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_global_registry_is_clean():
+    lint = _load_lint()
+    problems = lint.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def test_lint_catches_bad_registrations():
+    from juicefs_tpu.metric import Registry
+
+    lint = _load_lint()
+    reg = Registry()
+    reg.counter("not_prefixed", "has help")
+    reg.gauge("juicefs_no_help", "")
+    # conflicting duplicate: same name, different kind
+    reg.counter("juicefs_dup", "a counter")
+    reg.gauge("juicefs_dup", "now a gauge")
+    # conflicting duplicate: same name/kind, different label set
+    reg.counter("juicefs_dup2", "labeled", ("a",))
+    reg.counter("juicefs_dup2", "labeled", ("a", "b"))
+    problems = lint.lint(registry=reg)
+    text = "\n".join(problems)
+    assert "not_prefixed" in text
+    assert "juicefs_no_help" in text
+    assert "juicefs_dup:" in text
+    assert "juicefs_dup2:" in text
+
+
+def test_benign_re_registration_is_not_flagged():
+    from juicefs_tpu.metric import Registry
+
+    reg = Registry()
+    a = reg.counter("juicefs_same", "help", ("x",))
+    b = reg.counter("juicefs_same", "help", ("x",))
+    assert a is b
+    assert reg.conflicts == []
+
+
+def test_cli_entrypoint_exits_zero():
+    import subprocess
+
+    p = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "lint_metrics.py")],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 0, p.stderr
+    assert "OK" in p.stdout

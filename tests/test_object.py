@@ -18,16 +18,22 @@ from juicefs_tpu.object import (
 
 
 def _stores(tmp_path):
-    pem = generate_rsa_key_pem(2048)
-    return {
+    out = {
         "mem": MemStorage(),
         "file": FileStorage(str(tmp_path / "file")),
         "prefix": with_prefix(MemStorage(), "vol1/"),
         "sharded": sharded([MemStorage() for _ in range(4)]),
         "checksum": new_checksummed(MemStorage()),
-        "encrypted": new_encrypted(MemStorage(), pem),
-        "enc+sum": new_checksummed(new_encrypted(FileStorage(str(tmp_path / "es")), pem)),
     }
+    from juicefs_tpu.object.encrypt import HAVE_CRYPTOGRAPHY
+
+    if HAVE_CRYPTOGRAPHY:  # gated dep: encrypted variants need the wheel
+        pem = generate_rsa_key_pem(2048)
+        out["encrypted"] = new_encrypted(MemStorage(), pem)
+        out["enc+sum"] = new_checksummed(
+            new_encrypted(FileStorage(str(tmp_path / "es")), pem)
+        )
+    return out
 
 
 def _make_s3_env(tmp_path):
@@ -112,7 +118,10 @@ def store(request, tmp_path):
         srv.stop()
         v.close()
         return
-    s = _stores(tmp_path)[request.param]
+    stores = _stores(tmp_path)
+    if request.param not in stores:
+        pytest.skip(f"{request.param} store unavailable (cryptography not installed)")
+    s = stores[request.param]
     s.create()
     yield s
 
@@ -301,6 +310,7 @@ def test_checksum_detects_corruption():
 
 
 def test_encryption_hides_content():
+    pytest.importorskip("cryptography")
     inner = MemStorage()
     s = new_encrypted(inner, generate_rsa_key_pem())
     s.put("secret", b"top secret data" * 100)
@@ -390,6 +400,7 @@ def test_encryption_variants_ecies_and_ctr(tmp_path):
     """Reference encrypt.go:136-216 variants (VERDICT r3 missing #7):
     ECIES key wrap (EC P-256 PEM) and AES-256-CTR bodies, in all four
     combinations, with full roundtrips + wrong-key rejection."""
+    pytest.importorskip("cryptography")
     import os
 
     import pytest as _pytest

@@ -1,0 +1,76 @@
+"""Metric registry lint (CI check, invoked from the test suite).
+
+Imports every module that registers metrics at import time, then walks the
+global registry and fails on:
+
+  - names missing the `juicefs_` prefix (one namespace for every exporter);
+  - missing help strings (Grafana/`stats` render them);
+  - conflicting duplicate registrations (same name re-registered with a
+    different type or label set — the silent first-wins behavior would
+    otherwise swallow one of them).
+
+Run directly (`python tools/lint_metrics.py`, exit 1 on problems) or call
+`lint()` from a test.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _populate_registry() -> None:
+    """Import the modules whose metrics register at import time, and the
+    runtime registrations that are cheap to trigger."""
+    import juicefs_tpu.chunk.cached_store   # noqa: F401  retries counter
+    import juicefs_tpu.chunk.disk_cache     # noqa: F401  disk tier counters
+    import juicefs_tpu.chunk.mem_cache      # noqa: F401  cache hit/miss/evict
+    import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
+    import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
+    import juicefs_tpu.metric.trace         # noqa: F401  stage rollup histogram
+    import juicefs_tpu.object.metered       # noqa: F401  per-backend op meters
+    import juicefs_tpu.object.sharding      # noqa: F401  shard routing counter
+    import juicefs_tpu.tpu.pipeline         # noqa: F401  batch metrics
+    from juicefs_tpu.metric import register_process_metrics
+
+    register_process_metrics()
+
+
+def lint(registry=None) -> list[str]:
+    """Return a list of problems (empty = clean). With an explicit
+    registry, lint it as-is; only the global registry needs the
+    metric-registering modules imported first."""
+    from juicefs_tpu.metric import global_registry
+
+    if registry is None:
+        _populate_registry()
+    reg = registry or global_registry()
+    problems: list[str] = []
+    for m in reg.walk():
+        if not m.name.startswith("juicefs_"):
+            problems.append(f"{m.name}: metric name lacks the juicefs_ prefix")
+        if not m.help.strip():
+            problems.append(f"{m.name}: missing help string")
+        if m.kind not in ("counter", "gauge", "histogram"):
+            problems.append(f"{m.name}: unknown metric kind {m.kind!r}")
+    problems.extend(reg.conflicts)
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    if problems:
+        for p in problems:
+            print(f"lint_metrics: {p}", file=sys.stderr)
+        return 1
+    from juicefs_tpu.metric import global_registry
+
+    print(f"lint_metrics: {len(global_registry().walk())} metrics OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
