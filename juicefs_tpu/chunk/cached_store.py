@@ -30,6 +30,7 @@ from ..object.metered import metered
 from ..utils import get_logger
 from .disk_cache import CacheManager, DiskCache
 from .mem_cache import MemCache
+from .parallel import fetch_ordered
 from .prefetch import Prefetcher
 from .singleflight import SingleFlight
 
@@ -258,21 +259,40 @@ class CachedStore:
     def new_reader(self, sid: int, length: int) -> "RSlice":
         return RSlice(self, sid, length)
 
-    def remove(self, sid: int, length: int) -> None:
-        for key, _ in self._block_range(sid, length):
+    def remove(self, sid: int, length: int) -> int:
+        """Delete every block of a slice; DELETEs run in parallel on the
+        download pool.  A NotFoundError is idempotent success (the block
+        was already gone — retries, crashed removals, racing gc), so only
+        real backend failures are logged and counted.  Returns the number
+        of real failures."""
+        def drop(key: str) -> int:
             self.cache.remove(key)
             with self._pending_lock:
                 self._pending_staged.pop(key, None)
             try:
-                self._with_retry(f"DELETE {key}", lambda k=key: self.storage.delete(k))
+                self._with_retry(f"DELETE {key}", lambda: self.storage.delete(key))
+            except NotFoundError:
+                pass
             except Exception as e:
                 logger.warning("remove %s: %s", key, e)
+                return 1
+            return 0
+
+        return sum(failed for _, failed in fetch_ordered(
+            [key for key, _ in self._block_range(sid, length)],
+            drop, self._rpool, self.conf.max_download,
+        ))
 
     def fill_cache(self, sid: int, length: int) -> None:
-        """Warm every block of a slice (reference vfs/fill.go FillCache)."""
+        """Warm every block of a slice (reference vfs/fill.go FillCache);
+        loads overlap on the download pool, failures propagate."""
         if length > 0:
-            for key, bsize in self._block_range(sid, length):
-                self._load_block(key, bsize)
+            for _ in fetch_ordered(
+                list(self._block_range(sid, length)),
+                lambda kb: self._load_block(kb[0], kb[1]),
+                self._rpool, self.conf.max_download,
+            ):
+                pass
 
     def check_cache(self, sid: int, length: int) -> int:
         """Number of cached blocks for a slice."""
@@ -314,7 +334,8 @@ class CachedStore:
     def close(self) -> None:
         """Orderly shutdown: drain uploads, stop workers, free dir locks."""
         self._pool.shutdown(wait=True)
-        self._rpool.shutdown(wait=False)
+        self._fetcher.close()  # stop issuing new loads before teardown
+        self._rpool.shutdown(wait=True, cancel_futures=True)
         if self.indexer is not None:
             try:
                 self.indexer.close()

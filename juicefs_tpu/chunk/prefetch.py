@@ -33,6 +33,8 @@ _H_FETCH = stage_hist("chunk", "prefetch", "fetch")
 
 _WARMED_CAP = 4096  # bounded issued-block memory for used-accounting
 
+_STOP = object()  # close() sentinel: one per worker, never a real key
+
 
 class Prefetcher:
     def __init__(self, fetch: Callable[[Hashable], None], workers: int = 2, depth: int = 64):
@@ -71,9 +73,27 @@ class Prefetcher:
             if self._warmed.pop(key, 0) is None:
                 _USED.inc()
 
+    def close(self) -> None:
+        """Stop the workers (one sentinel each; workers exit exactly once).
+        The queue is drained first so sentinels are next in line — close
+        means the owner no longer wants the cache warmed, and a backlog
+        against a slow backend must not stall teardown (workers only
+        finish the fetch they already started)."""
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        for _ in self._threads:
+            self._q.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=5.0)
+
     def _run(self) -> None:
         while True:
             key = self._q.get()
+            if key is _STOP:
+                return
             try:
                 with _TR.span("chunk", "prefetch", stage="fetch",
                               hist=_H_FETCH) as sp:

@@ -71,6 +71,10 @@ def chunk_conf(fmt: Format, args=None) -> ChunkConfig:
     )
     if getattr(args, "cache_size", None):
         conf.cache_size = int(args.cache_size) << 20
+    # bulk commands (gc --threads) govern the parallel-fetch window; the
+    # download pool must be at least that wide for the window to bite
+    if getattr(args, "threads", None):
+        conf.max_download = max(conf.max_download, int(args.threads))
     return conf
 
 

@@ -95,13 +95,14 @@ def run(args) -> int:
         from ..chunk.indexer import pipeline_backend
 
         backend = args.hash_backend or pipeline_backend(fmt.hash_backend)
-        stats = dedup_scan(m, store, live, backend, args.dedup_index, bs)
+        stats = dedup_scan(m, store, live, backend, args.dedup_index, bs,
+                           threads=args.threads)
         print(json.dumps(stats))
     return 0
 
 
 def dedup_scan(meta, store, live: dict[str, int], backend: str,
-               index_path: str, block_size: int) -> dict:
+               index_path: str, block_size: int, threads: int = 8) -> dict:
     """Content-dedup scan over all live blocks.
 
     Incremental: digests recorded by the write path (meta content index,
@@ -109,9 +110,15 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
     are read back and hashed, and their rows are backfilled so the next
     scan is O(new data). Index rows whose slice no longer exists are
     pruned here — the index is advisory and self-healing.
+
+    Object GETs run `threads` deep through the ordered parallel-fetch
+    stage (chunk/parallel.py), overlapping storage I/O with TPU hash
+    dispatch; results arrive in input order, so digests and index rows
+    are byte-identical to the old serial walk.
     """
     import time as _time
 
+    from ..chunk.parallel import FetchStats, fetch_ordered
     from ..tpu.dedup import dedup_digests
     from ..tpu.jth256 import digest_hex
     from ..tpu.pipeline import HashPipeline, PipelineConfig
@@ -136,17 +143,18 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
     pipe = HashPipeline(
         PipelineConfig(backend=backend, pad_lanes=max(1, block_size // 65536))
     )
-    read_s = [0.0]
+    window = max(1, threads)
+    fstats = FetchStats()
 
     def blocks():
-        for key in missing:
-            try:
-                r0 = _time.perf_counter()
-                data = store._load_block(key, live[key], cache_after=False)
-                read_s[0] += _time.perf_counter() - r0
-                yield key, data
-            except Exception as e:
-                logger.warning("read %s: %s", key, e)
+        # windowed parallel GETs on the store's download pool, yielded in
+        # input order straight into the hash pipeline; a bad block is
+        # skipped (and logged by the stage), never aborts the scan
+        yield from fetch_ordered(
+            missing,
+            lambda key: store._load_block(key, live[key], cache_after=False),
+            store._rpool, window, on_error="skip", stats=fstats,
+        )
 
     t1 = _time.perf_counter()
     backfill = []
@@ -190,14 +198,20 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
         "duplicate_bytes": int(dup_bytes),
         "dedup_groups": len(groups),
         "backend": backend,
-        # stage breakdown (VERDICT r3 #2: the bottleneck must be explicit)
+        "fetch_window": window,
+        # stage breakdown (VERDICT r3 #2: the bottleneck must be explicit).
+        # `get` is WALL time the fetch stage had GETs in flight;
+        # `get_threads` is aggregate per-thread GET seconds — their ratio
+        # is the achieved I/O overlap factor (ISSUE 2), and `hash` is the
+        # read+hash wall not hidden behind the fetch window.
         "seconds": round(total, 3),
         "gibs": round(nbytes / (1 << 30) / total, 3) if total > 0 else 0.0,
         "blocks_per_s": round(len(keys) / total, 1) if total > 0 else 0.0,
         "stage_seconds": {
             "index_load": round(t_index, 3),
-            "get": round(read_s[0], 3),
-            "hash": round(max(t_readhash - read_s[0], 0.0), 3),
+            "get": round(fstats.wall, 3),
+            "get_threads": round(fstats.seconds, 3),
+            "hash": round(max(t_readhash - fstats.wall, 0.0), 3),
             "meta_backfill": round(t_meta, 3),
             "dup_group": round(t_group, 3),
         },

@@ -98,7 +98,10 @@ class BaseMeta(interface.Meta):
     def do_getattr(self, ino: int) -> tuple[int, Attr]: ...
     def do_setattr(self, ctx, ino, flags, attr: Attr) -> tuple[int, Attr]: ...
     def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path) -> tuple[int, int, Attr]: ...
-    def do_unlink(self, ctx, parent, name, skip_trash=False) -> int: ...
+    def do_unlink(self, ctx, parent, name, skip_trash=False) -> tuple[int, int]:
+        """Returns (st, victim_ino); the victim is resolved inside the
+        transaction so callers can invalidate caches race-free."""
+        ...
     def do_rmdir(self, ctx, parent, name, skip_trash=False) -> int: ...
     def do_rename(self, ctx, psrc, nsrc, pdst, ndst, flags) -> tuple[int, int, Attr]: ...
     def do_link(self, ctx, ino, parent, name) -> tuple[int, Attr]: ...
@@ -557,8 +560,12 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st
-        st = self.do_unlink(ctx, parent, name, skip_trash)
+        st, ino = self.do_unlink(ctx, parent, name, skip_trash)
         if st == 0:
+            if ino:
+                # the victim's nlink/ctime changed: a hardlink sibling
+                # must not keep serving its open-file cached attr
+                self.of.invalidate(ino)
             self._note_change(("e", parent, bytes(name)), ("a", parent))
         return st
 
@@ -585,6 +592,9 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, pdst, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st, 0, Attr()
+        # a replaced/exchanged destination's open-file cached attr is
+        # invalidated by the engine itself (victim resolved inside the
+        # rename transaction, so concurrent renames cannot desync it)
         st, ino, attr = self.do_rename(ctx, psrc, nsrc, pdst, ndst, flags)
         if st == 0:
             self.of.invalidate(ino)
@@ -681,6 +691,8 @@ class BaseMeta(interface.Meta):
             st, attr = self.do_getattr(ino)
             if st:
                 return st, Attr()
+            if attr.typ == TYPE_DIRECTORY:
+                return errno.EISDIR, Attr()  # truncate(2) on a directory
             st = self.access(ctx, ino, MODE_MASK_W, attr)
             if st:
                 return st, Attr()
@@ -817,6 +829,23 @@ class BaseMeta(interface.Meta):
                 s.length += cattr.length
                 s.size += (cattr.length + 4095) // 4096 * 4096
 
+    @staticmethod
+    def _is_ancestor(get_attr, anc: int, start: int) -> bool:
+        """True when `anc` is `start` or an ancestor of it, walking parent
+        pointers to the root.  `get_attr` is the engine's in-transaction
+        attr fetch; the walk stops on orphaned or self-parented nodes.
+        Shared by both rename cycle checks (a dir must not move under its
+        own subtree, nor be exchanged under one of its descendants)."""
+        p = start
+        while p and p != ROOT_INODE:
+            if p == anc:
+                return True
+            pa = get_attr(p)
+            if pa is None or pa.parent == p:
+                break
+            p = pa.parent
+        return False
+
     def remove_recursive(self, ctx, parent: int, name: bytes, skip_trash=False) -> tuple[int, int]:
         """rmr: post-order delete, iterative so arbitrarily deep trees cannot
         exhaust the Python stack (reference base.go Remove / cmd rmr)."""
@@ -825,7 +854,9 @@ class BaseMeta(interface.Meta):
             return st, 0
         removed = 0
         if attr.typ != TYPE_DIRECTORY:
-            st = self.do_unlink(ctx, parent, name, skip_trash)
+            st, vino = self.do_unlink(ctx, parent, name, skip_trash)
+            if st == 0 and vino:
+                self.of.invalidate(vino)
             return st, (1 if st == 0 else 0)
         # stack holds (parent, name, ino, expanded); a dir is deleted only
         # after its expanded children have been processed
@@ -846,9 +877,11 @@ class BaseMeta(interface.Meta):
                 if e.attr.typ == TYPE_DIRECTORY:
                     stack.append((i, e.name, e.inode, False))
                 else:
-                    st = self.do_unlink(ctx, i, e.name, skip_trash)
+                    st, vino = self.do_unlink(ctx, i, e.name, skip_trash)
                     if st:
                         return st, removed
+                    if vino:
+                        self.of.invalidate(vino)
                     removed += 1
         return 0, removed
 

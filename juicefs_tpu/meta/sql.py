@@ -710,13 +710,16 @@ class SQLMeta(BaseMeta):
             attr.touch_ctime(now)
             self._put_node(cur, ino, attr)
 
-    def do_unlink(self, ctx, parent, name, skip_trash=False) -> int:
+    def do_unlink(self, ctx, parent, name, skip_trash=False) -> tuple[int, int]:
         trash = self.fmt.trash_days > 0 and not skip_trash and parent < TRASH_INODE
+        victim = [0]  # resolved inside the txn: races with a concurrent
+        # rename-onto-name cannot desync it from the deleted entry
 
         def fn(cur):
             typ, ino = self._get_edge(cur, parent, name)
             if ino == 0:
                 return errno.ENOENT
+            victim[0] = ino
             if typ == TYPE_DIRECTORY:
                 return errno.EISDIR
             pattr = self._get_node(cur, parent)
@@ -782,7 +785,8 @@ class SQLMeta(BaseMeta):
             self._update_used(cur, -_align4k(attr.length), -1)
             return 0
 
-        return self._txn(fn)
+        st = self._txn(fn)
+        return st, victim[0] if st == 0 else 0
 
     def do_rmdir(self, ctx, parent, name, skip_trash=False) -> int:
         trash = self.fmt.trash_days > 0 and not skip_trash and parent < TRASH_INODE
@@ -827,6 +831,7 @@ class SQLMeta(BaseMeta):
     def do_rename(self, ctx, psrc, nsrc, pdst, ndst, flags) -> tuple[int, int, Attr]:
         if flags & ~(RENAME_NOREPLACE | RENAME_EXCHANGE):
             return errno.ENOTSUP, 0, Attr()
+        victim = [0]  # replaced/exchanged destination, resolved in-txn
 
         def fn(cur):
             styp, sino = self._get_edge(cur, psrc, nsrc)
@@ -844,19 +849,29 @@ class SQLMeta(BaseMeta):
                 return errno.ENOTDIR, 0, Attr()
             if self._sticky_violation(spattr, sattr, ctx):
                 return errno.EACCES, 0, Attr()
-            if styp == TYPE_DIRECTORY and psrc != pdst:
-                p = pdst
-                while p and p != ROOT_INODE:
-                    if p == sino:
-                        return errno.EINVAL, 0, Attr()
-                    pa = self._get_node(cur, p)
-                    if pa is None or pa.parent == p:
-                        break
-                    p = pa.parent
+            if (styp == TYPE_DIRECTORY and psrc != pdst
+                    and self._is_ancestor(lambda i: self._get_node(cur, i),
+                                          sino, pdst)):
+                return errno.EINVAL, 0, Attr()
             dtyp, dino = self._get_edge(cur, pdst, ndst)
+            victim[0] = dino if dino != sino else 0
+            # the mirrored cycle: exchanging puts the DESTINATION dir
+            # under psrc, so dino must not be an ancestor of psrc either
+            # (kernel: EINVAL), or it becomes its own child
+            if (flags & RENAME_EXCHANGE and dino and dtyp == TYPE_DIRECTORY
+                    and psrc != pdst
+                    and self._is_ancestor(lambda i: self._get_node(cur, i),
+                                          dino, psrc)):
+                return errno.EINVAL, 0, Attr()
             now = time.time()
             if dino and flags & RENAME_NOREPLACE:
                 return errno.EEXIST, 0, Attr()
+            if dino == sino and not flags & RENAME_EXCHANGE:
+                # POSIX: old and new are directory entries for the same
+                # file (hardlinks) -> succeed and change NOTHING; both
+                # names remain (the kernel's vfs_rename short-circuits
+                # this before any fs op)
+                return 0, sino, sattr
             squota = dquota = None
             move_space = move_inodes = 0
             if psrc != pdst:
@@ -979,7 +994,12 @@ class SQLMeta(BaseMeta):
                     self._quota_update(cur, pdst, extra_s, extra_i)
             return 0, sino, sattr
 
-        return self._txn(fn)
+        st, ino, attr = self._txn(fn)
+        if st == 0 and victim[0]:
+            # the destination's nlink/ctime changed (decref on replace,
+            # reparent on exchange): evict its open-file cached attr
+            self.of.invalidate(victim[0])
+        return st, ino, attr
 
     def _free_entry(self, cur, parent: int, name: bytes, typ: int, ino: int, attr, now) -> int:
         """Drop the entry at (parent, name) whose inode is being replaced."""
@@ -1032,13 +1052,15 @@ class SQLMeta(BaseMeta):
             attr = self._get_node(cur, ino)
             if attr is None:
                 return errno.ENOENT, Attr()
+            # an existing destination wins over EPERM-class refusals
+            # (kernel linkat checks newpath existence first)
+            etyp, _ = self._get_edge(cur, parent, name)
+            if etyp:
+                return errno.EEXIST, Attr()
             if attr.typ == TYPE_DIRECTORY:
                 return errno.EPERM, Attr()
             if attr.flags & FLAG_IMMUTABLE:
                 return errno.EPERM, Attr()
-            etyp, _ = self._get_edge(cur, parent, name)
-            if etyp:
-                return errno.EEXIST, Attr()
             pattr = self._get_node(cur, parent)
             if pattr is None:
                 return errno.ENOENT, Attr()

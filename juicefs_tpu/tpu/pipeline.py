@@ -49,6 +49,12 @@ class PipelineConfig:
     # Pad every batch to this many lanes so one compiled program serves the
     # whole stream (4 MiB default block = 64 lanes).
     pad_lanes: int = BLOCK_BYTES // LANE_BYTES
+    # Dispatched-but-undrained batches allowed before hash_stream blocks on
+    # the oldest result.  2 = classic double buffering (device hashes batch
+    # k while the host packs k+1); deeper keeps the device busy across a
+    # fetch hiccup upstream at the cost of one packed batch of host RAM per
+    # extra slot.
+    max_inflight_batches: int = 2
 
 
 class HashPipeline:
@@ -131,9 +137,10 @@ class HashPipeline:
             blocks.append(data)
             if len(blocks) >= cfg.batch_blocks:
                 dispatch()
-                # Keep exactly one batch in flight: async dispatch means the
-                # device hashes batch k while the host packs batch k+1.
-                while len(pending) > 1:
+                # Async dispatch: the device hashes batch k while the host
+                # packs later ones; block only past the configured depth.
+                depth = max(1, cfg.max_inflight_batches)
+                while len(pending) >= depth:
                     yield from drain(pending.pop(0))
         dispatch()
         while pending:

@@ -10,6 +10,9 @@ meta swap keeps any slices appended after the snapshot.
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
+
+from ..chunk.parallel import fetch_ordered
 from ..meta.slice import build_slice
 from ..meta.types import Slice
 from ..utils import get_logger
@@ -17,6 +20,11 @@ from ..utils import get_logger
 logger = get_logger("vfs.compact")
 
 MIN_SLICES_TO_COMPACT = 2
+# segment-read fan-out per compaction; a transient pool, NOT the store's
+# download pool: RSlice.read submits block loads there and waits, and a
+# bounded pool waiting on itself deadlocks (docs/ARCHITECTURE.md
+# "Concurrency model")
+COMPACT_READ_WINDOW = 4
 
 
 def compact_chunk(meta, store, ino: int, indx: int) -> bool:
@@ -34,13 +42,21 @@ def compact_chunk(meta, store, ino: int, indx: int) -> bool:
 
     new_id = meta.new_slice()
     ws = store.new_writer(new_id)
+
+    def read_seg(seg):
+        if seg.id == 0:
+            return b"\0" * seg.len
+        return store.new_reader(seg.id, seg.size).read(seg.off, seg.len)
+
+    window = min(COMPACT_READ_WINDOW, len(view))
     try:
-        for seg in view:
-            if seg.id == 0:
-                ws.write_at(b"\0" * seg.len, seg.pos)
-            else:
-                rs = store.new_reader(seg.id, seg.size)
-                data = rs.read(seg.off, seg.len)
+        # overlap the old slices' reads; in-order yield keeps the writer
+        # sequential.  A failed read is corruption here, so it raises and
+        # aborts the rewrite (error policy opposite of the gc scan's).
+        with ThreadPoolExecutor(
+            max_workers=window, thread_name_prefix="compact-read"
+        ) as pool:
+            for seg, data in fetch_ordered(view, read_seg, pool, window):
                 ws.write_at(data, seg.pos)
         ws.finish(length)
     except Exception as e:

