@@ -481,6 +481,12 @@ def run_e2e(gib: float, backends: list[str], block_mib: int = 4,
         from juicefs_tpu.metric.trace import stage_metrics_snapshot
 
         out["stage_metrics"] = stage_metrics_snapshot()
+        # resilience activity (ISSUE 3): retry/hedge/abandon/breaker
+        # counters — a scan paying for retries or hedges must show it in
+        # the perf trajectory, not hide it inside the GET wall time
+        from juicefs_tpu.object.resilient import resilience_snapshot
+
+        out["resilience"] = resilience_snapshot()
         return out
     finally:
         if not keep_dir:

@@ -27,6 +27,14 @@ from ..metric import global_registry
 from ..metric.trace import global_tracer, stage_hist
 from ..object.interface import NotFoundError, ObjectStorage
 from ..object.metered import metered
+from ..object.resilient import (
+    BreakerOpenError,
+    CircuitBreaker,
+    ErrorClass,
+    RetryPolicy,
+    record_retry,
+    resilient,
+)
 from ..utils import get_logger
 from .disk_cache import CacheManager, DiskCache
 from .mem_cache import MemCache
@@ -37,11 +45,6 @@ from .singleflight import SingleFlight
 logger = get_logger("chunk.store")
 
 _TR = global_tracer()
-_RETRIES = global_registry().counter(
-    "juicefs_object_request_retries",
-    "Object requests retried after a transient failure",
-    ("method",),
-)
 _H_READ = stage_hist("chunk", "read", "total")
 _H_FETCH = stage_hist("chunk", "load", "fetch")
 _H_UPLOAD = stage_hist("chunk", "upload", "put")
@@ -102,19 +105,46 @@ class ChunkConfig:
     max_download: int = 8
     max_retries: int = 10
     prefetch: int = 2
+    # object-plane resilience (object/resilient.py): per-op wall budget,
+    # per-attempt abandonment bound, hedged GETs.  retry_policy/breaker
+    # override the scalar knobs wholesale (tests, tuned deployments).
+    op_deadline: float = 60.0
+    attempt_timeout: Optional[float] = None
+    hedge: bool = True
+    hedge_delay: Optional[float] = None  # None = auto from live p95
+    retry_policy: Optional["RetryPolicy"] = None
+    breaker: Optional["CircuitBreaker"] = None
     # hook for the TPU fingerprint plane: called with (key, raw_block)
     # on every upload (SURVEY.md §7.4); None disables
     fingerprint: Optional[Callable[[str, bytes], None]] = None
+
+
+class TornDataError(IOError):
+    """The backend 'succeeded' but returned the wrong number of bytes
+    (truncated transfer, flaky proxy).  Retried by the chunk layer's own
+    loop — the resilience wrapper below only sees clean responses."""
 
 
 class CachedStore:
     """reference cached_store.go:636 cachedStore / NewCachedStore:751"""
 
     def __init__(self, storage: ObjectStorage, config: ChunkConfig | None = None):
-        # the metering wrapper (object/metered.py) sits beneath the cache,
-        # above the wire driver — the true object boundary; idempotent
-        self.storage = metered(storage)
         self.conf = config or ChunkConfig()
+        # canonical wrapper stack (both idempotent): resilience above
+        # metering — each attempt/hedge is individually metered, and the
+        # hedge delay reads the live per-backend GET histogram
+        policy = self.conf.retry_policy or RetryPolicy(
+            deadline=self.conf.op_deadline,
+            max_attempts=max(1, self.conf.max_retries),
+            attempt_timeout=self.conf.attempt_timeout,
+        )
+        self.storage = resilient(
+            metered(storage), policy=policy, breaker=self.conf.breaker,
+            hedge=self.conf.hedge, hedge_delay=self.conf.hedge_delay,
+        )
+        # degradation ladder, recovery rung: when the breaker resets,
+        # replay every block that degraded writes parked in staging
+        self.storage.breaker.on_reset(self._replay_staged)
         self.compressor = new_compressor(self.conf.compress)
         if self.conf.cache_dirs == ("memory",):
             self.cache = MemCache(self.conf.cache_size)
@@ -140,23 +170,30 @@ class CachedStore:
             self._recover_staging()
 
     # -- helpers -----------------------------------------------------------
-    def _with_retry(self, op: str, fn: Callable[[], object]):
-        last: Exception | None = None
-        for attempt in range(self.conf.max_retries):
+    def _retry_torn(self, op: str, fn: Callable[[], object]):
+        """Retry torn responses (TornDataError only).  Storage-level
+        faults are classified and retried INSIDE the resilience wrapper
+        (object/resilient.py); this loop covers the one failure the
+        wrapper cannot see — a response that arrived whole-looking but
+        fails the chunk layer's length validation."""
+        policy = self.storage.policy
+        attempts = max(1, self.conf.max_retries)
+        for attempt in range(attempts):
             try:
                 return fn()
-            except NotFoundError:
-                raise
-            except Exception as e:
-                last = e
-                if attempt + 1 < self.conf.max_retries:
-                    # count only attempts that WILL be retried; the terminal
-                    # failure raises and is an error, not a retry
-                    _RETRIES.labels(op.split(" ", 1)[0]).inc()
-                sleep = min(0.01 * (attempt + 1) ** 2, 3.0)  # quadratic backoff
-                logger.warning("%s failed (try %d): %s", op, attempt + 1, e)
-                time.sleep(sleep)
-        raise last  # type: ignore[misc]
+            except TornDataError as e:
+                if attempt + 1 >= attempts:
+                    raise
+                record_retry(op.split(" ", 1)[0], ErrorClass.TRANSIENT)
+                logger.warning("%s torn (try %d): %s", op, attempt + 1, e)
+                time.sleep(policy.backoff(attempt, ErrorClass.TRANSIENT))
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    @property
+    def degraded(self) -> bool:
+        """True while the object backend's breaker is open (the store is
+        running on the degradation ladder)."""
+        return bool(getattr(self.storage, "degraded", False))
 
     def _put_block(self, key: str, raw: bytes, parent=None) -> None:
         """Compress (+fingerprint) and PUT one block
@@ -169,7 +206,7 @@ class CachedStore:
             if self.conf.fingerprint is not None:
                 self.conf.fingerprint(key, raw)
             data = self.compressor.compress(raw)
-            self._with_retry(f"PUT {key}", lambda: self.storage.put(key, data))
+            self.storage.put(key, data)
 
     def _note_cache_hit(self, key: str, bsize: int) -> None:
         """Prefetch effectiveness: credit the prefetcher when a hit
@@ -203,7 +240,7 @@ class CachedStore:
                 if len(raw) != bsize:
                     # short/over-long response (flaky backend, truncated
                     # transfer): retryable, NOT a permanent failure
-                    raise IOError(
+                    raise TornDataError(
                         f"block {key}: expect {bsize} bytes, got {len(raw)}"
                     )
                 return raw
@@ -212,7 +249,9 @@ class CachedStore:
                           parent=parent) as sp:
                 if sp.active:
                     sp.set(key=key, bytes=bsize)
-                raw = self._with_retry(f"GET {key}", fetch)
+                # breaker open + cache miss: storage.get fails fast with
+                # BreakerOpenError (EIO) — the ladder's bottom rung
+                raw = self._retry_torn(f"GET {key}", fetch)
             if cache_after:
                 self.cache.cache(key, raw)
             return raw
@@ -223,11 +262,13 @@ class CachedStore:
         """Returns True only when this call actually warmed the block
         (Prefetcher credits juicefs_prefetch_used from that)."""
         key, bsize = key_size
+        if self.degraded:
+            return False  # outage: warming would only burn EIO fast-fails
         if self.cache.load(key, count_miss=False) is None:
             try:
                 self._load_block(key, bsize)
                 return True
-            except NotFoundError:
+            except (NotFoundError, BreakerOpenError):
                 pass
         return False
 
@@ -270,7 +311,7 @@ class CachedStore:
             with self._pending_lock:
                 self._pending_staged.pop(key, None)
             try:
-                self._with_retry(f"DELETE {key}", lambda: self.storage.delete(key))
+                self.storage.delete(key)
             except NotFoundError:
                 pass
             except Exception as e:
@@ -341,6 +382,10 @@ class CachedStore:
                 self.indexer.close()
             except Exception:
                 pass
+        try:  # resilience resources (probe thread, abandon pool) only —
+            self.storage.close()  # the inner store belongs to its owner
+        except Exception:
+            pass
         self.release_cache_locks()
 
     # -- writeback recovery ------------------------------------------------
@@ -371,9 +416,45 @@ class CachedStore:
         try:
             self._put_block(key, raw, parent)
             self.cache.uploaded(key, len(raw))
-        finally:
+        except BreakerOpenError:
+            # outage ladder: keep the block parked in staging — the
+            # breaker-reset replay re-submits it (popping here would lose
+            # the in-process copy and force a restart-scan to recover)
+            logger.warning("upload %s deferred: breaker open", key)
+            return
+        except Exception:
             with self._pending_lock:
                 self._pending_staged.pop(key, None)
+            raise
+        with self._pending_lock:
+            self._pending_staged.pop(key, None)
+
+    def _put_or_stage(self, key: str, raw: bytes, parent=None) -> None:
+        """Async upload worker for the non-writeback path: a breaker that
+        opened mid-flight degrades the write to staging (ladder rung 2)
+        instead of failing an already-acked buffer back to the caller."""
+        try:
+            self._put_block(key, raw, parent)
+        except BreakerOpenError:
+            self.cache.stage(key, raw)
+            with self._pending_lock:
+                self._pending_staged[key] = raw
+            logger.warning("degraded write: %s staged for replay", key)
+
+    def _replay_staged(self) -> None:
+        """Breaker-reset hook: re-upload every block degraded writes (or
+        a mid-outage writeback backlog) parked in `_pending_staged` —
+        recovery must not wait for new traffic."""
+        with self._pending_lock:
+            items = list(self._pending_staged.items())
+        if not items:
+            return
+        logger.warning("breaker reset: replaying %d staged blocks", len(items))
+        for key, raw in items:
+            try:
+                self._pool.submit(self._upload_staged, key, raw)
+            except RuntimeError:
+                return  # pool already shut down: restart recovery owns it
 
 
 class WSlice:
@@ -433,23 +514,30 @@ class WSlice:
         self._uploaded.add(indx)
         key = block_key(self.id, indx, bsize)
         ref = _TR.current_ref()  # link pool-side upload spans to this write
-        if self.store.conf.writeback:
+        degraded = self.store.degraded
+        if self.store.conf.writeback or degraded:
             # stage to disk, ack immediately, upload in background
-            # (reference cached_store.go:415-472 writeback branch)
+            # (reference cached_store.go:415-472 writeback branch).  With
+            # the breaker OPEN this branch is FORCED even without
+            # --writeback: the write degrades to staging with zero backend
+            # calls and the breaker-reset replay uploads it (ISSUE 3
+            # degradation ladder).
             with _TR.span("chunk", "upload", stage="stage", hist=_H_STAGE) as sp:
                 if sp.active:
                     sp.set(key=key, bytes=len(raw))
                 path = self.store.cache.stage(key, raw)
             with self.store._pending_lock:
                 self.store._pending_staged[key] = raw
-            if path is not None:
+            if degraded:
+                logger.warning("degraded write: %s staged for replay", key)
+            elif path is not None:
                 self.store._pool.submit(self.store._upload_staged, key, raw, ref)
             else:  # staging failed: fall back to sync-ish upload
                 self._futures.append(
                     self.store._pool.submit(self.store._upload_staged, key, raw, ref)
                 )
         else:
-            fut = self.store._pool.submit(self.store._put_block, key, raw, ref)
+            fut = self.store._pool.submit(self.store._put_or_stage, key, raw, ref)
             fut.add_done_callback(
                 lambda f, k=key, r=raw: self.store.cache.cache(k, r) if not f.exception() else None
             )
@@ -595,13 +683,13 @@ class RSlice:
                             data = self.store.storage.get(k, o, ln)
                             if len(data) != ln:
                                 # short read: retry, never return torn data
-                                raise IOError(
+                                raise TornDataError(
                                     f"ranged GET {k}[{o}:{o+ln}]: got "
                                     f"{len(data)} bytes"
                                 )
                             return data
 
-                        out += self.store._with_retry(
+                        out += self.store._retry_torn(
                             f"GET {key}[{boff}:{boff+n}]", ranged
                         )
                 else:

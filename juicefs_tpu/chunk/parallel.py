@@ -34,6 +34,7 @@ from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
 from ..metric import global_registry
 from ..object.interface import NotFoundError
+from ..object.resilient import BreakerOpenError
 from ..utils import get_logger
 
 logger = get_logger("chunk.parallel")
@@ -117,6 +118,10 @@ def fetch_ordered(
     for scans that must cover everything else (gc --dedup).  A
     NotFoundError under "skip" is logged at debug only: bulk scans racing
     deletions are expected.
+
+    A BreakerOpenError re-raises even under "skip": an open circuit is not
+    a per-item failure — every remaining item would fast-fail identically,
+    so the stage aborts instead of burning the whole input on EIO churn.
     """
     if on_error not in ("raise", "skip"):
         raise ValueError(f"on_error: {on_error!r}")
@@ -148,7 +153,7 @@ def fetch_ordered(
         try:
             yield item, fut.result()
         except Exception as e:
-            if on_error == "raise":
+            if on_error == "raise" or isinstance(e, BreakerOpenError):
                 raise
             if isinstance(e, NotFoundError):
                 logger.debug("fetch %s: %s", item, e)

@@ -95,6 +95,9 @@ class Prefetcher:
             if key is _STOP:
                 return
             try:
+                # (the store's fetch callable skips outright while its
+                # backend breaker is open — warming a dead backend would
+                # only queue EIO fast-fails; see CachedStore._prefetch_block)
                 with _TR.span("chunk", "prefetch", stage="fetch",
                               hist=_H_FETCH) as sp:
                     if sp.active:

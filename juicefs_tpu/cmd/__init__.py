@@ -75,6 +75,13 @@ def chunk_conf(fmt: Format, args=None) -> ChunkConfig:
     # download pool must be at least that wide for the window to bite
     if getattr(args, "threads", None):
         conf.max_download = max(conf.max_download, int(args.threads))
+    # object-plane resilience knobs (object/resilient.py)
+    if getattr(args, "op_deadline", None):
+        conf.op_deadline = float(args.op_deadline)
+    if getattr(args, "attempt_timeout", None):
+        conf.attempt_timeout = float(args.attempt_timeout)
+    if getattr(args, "no_hedge", False):
+        conf.hedge = False
     return conf
 
 

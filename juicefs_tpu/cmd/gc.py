@@ -188,6 +188,8 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
             )
     total = _time.perf_counter() - t0
     nbytes = sum(live.values())
+    from ..object.resilient import resilience_snapshot
+
     return {
         "blocks": len(keys),
         "bytes": nbytes,
@@ -215,4 +217,8 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
             "meta_backfill": round(t_meta, 3),
             "dup_group": round(t_group, 3),
         },
+        # retry/hedge/breaker activity during the scan (the GETs run
+        # through object/resilient.py): a scan that paid for fault
+        # handling must say so next to its throughput numbers
+        "resilience": resilience_snapshot(),
     }

@@ -42,8 +42,44 @@ def run_status(args) -> int:
         "sessions": [json.loads(s.to_json()) for s in sessions],
         "used_space": total - avail,
         "inodes_used": iused,
-    }, indent=2))
+        "object_plane": _object_plane_status(fmt),
+    }, indent=2, default=str))
     return 0
+
+
+def _object_plane_status(fmt) -> dict:
+    """Probe the volume's storage stack once from THIS process and report
+    the resilience configuration.  Deliberately NOT a breaker snapshot: a
+    freshly built stack always starts CLOSED/empty, and presenting that
+    as health would contradict a mount mid-outage.  Live breaker/ladder
+    state belongs to the mount's `.status` internal file."""
+    try:
+        from ..object.interface import NotFoundError
+        from ..object.resilient import resilient
+        from . import storage_for
+
+        store = resilient(storage_for(fmt))
+        try:
+            try:
+                store._s.head(".jfs-status-probe")  # direct: one attempt
+                probe = "ok"
+            except NotFoundError:
+                probe = "ok"
+            except Exception as e:
+                probe = f"unreachable: {e}"
+            h = store.health()
+            return {
+                "backend": h["backend"],
+                "probe": probe,
+                "policy": h["policy"],
+                "hedge": h["hedge"],
+                "live_state": "read <mountpoint>/.status on an active "
+                              "mount for breaker/ladder state",
+            }
+        finally:
+            store.close()
+    except Exception as e:  # status must never fail on a broken stack
+        return {"error": str(e)}
 
 
 def run_info(args) -> int:

@@ -27,6 +27,16 @@ def add_parser(sub):
     p.add_argument("--cache-dir", default="", help="colon-separated dirs or 'memory'")
     p.add_argument("--cache-size", default=0, type=int, help="cache size MiB")
     p.add_argument("--writeback", action="store_true")
+    p.add_argument("--op-deadline", type=float, default=0,
+                   help="object op wall budget in seconds (0 = default 60; "
+                        "hung backend calls are abandoned, never pin a "
+                        "worker)")
+    p.add_argument("--attempt-timeout", type=float, default=0,
+                   help="per-attempt object op bound in seconds (default: "
+                        "the remaining op deadline)")
+    p.add_argument("--no-hedge", action="store_true",
+                   help="disable hedged GETs (tail-latency duplicate "
+                        "requests after the live p95)")
     p.add_argument("--max-readahead", type=int, default=8, help="MiB")
     p.add_argument("--attr-cache", type=float, default=1.0,
                    help="attr cache TTL seconds (reference --attr-cache)")

@@ -27,9 +27,25 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..object import create_storage
+from ..object.resilient import RetryPolicy, resilient
 from ..utils import get_logger
 
 logger = get_logger("cmd.sync")
+
+
+def _open_store(uri: str):
+    """Sync endpoints go through the resilience wrapper (ISSUE 3: no
+    bare-store escapes): classified retries per object op, per-backend
+    breaker.  Hedging stays off — bulk copy already runs `--threads`
+    wide, and doubling GETs there is bandwidth, not tail latency.  The
+    wall deadline is effectively unbounded: a multi-GiB part on a slow
+    link may LEGITIMATELY take many minutes, and the wrapper cannot
+    know object sizes — the pre-existing contract (ops run to
+    completion, failed objects retry on later passes) stays intact."""
+    return resilient(create_storage(uri),
+                     policy=RetryPolicy(deadline=7 * 86400.0,
+                                        max_attempts=5),
+                     hedge=False)
 
 CMP_CHUNK = 8 << 20  # streaming-compare window
 
@@ -261,8 +277,8 @@ def run(args) -> int:
     if args.worker:
         return run_worker(args)
 
-    src = create_storage(args.src)
-    dst = create_storage(args.dst)
+    src = _open_store(args.src)
+    dst = _open_store(args.dst)
     dst.create()
 
     def filtered(store):
@@ -437,8 +453,8 @@ def run_worker(args) -> int:
         with urllib.request.urlopen(req, timeout=60) as resp:
             return json.loads(resp.read() or b"{}")
 
-    src = create_storage(args.src)
-    dst = create_storage(args.dst)
+    src = _open_store(args.src)
+    dst = _open_store(args.dst)
     stats = _new_stats()
     do = _make_executor(src, dst, args, stats)
     post("/register", {})

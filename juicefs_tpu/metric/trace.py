@@ -24,6 +24,7 @@ and pass it as `parent=`.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import threading
@@ -100,6 +101,16 @@ class _NullSpan:
 
 
 NULL_SPAN = _NullSpan()
+
+
+class _CarriedRef:
+    """Stack marker adopting a foreign (trace_id, span_id) as parent
+    (Tracer.carried); never emitted, only resolved against."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, ref: tuple[int, int]):
+        self.trace_id, self.span_id = ref
 
 
 class _TimedSpan:
@@ -213,6 +224,27 @@ class Tracer:
             top = stack[-1]
             return (top.trace_id, top.span_id)
         return None
+
+    @contextlib.contextmanager
+    def carried(self, ref: Optional[tuple[int, int]]):
+        """Adopt a captured (trace_id, span_id) as this thread's current
+        parent — the pool-crossing adapter for code that opens spans
+        *internally* (the metered object wrapper under the resilience
+        layer's worker pool).  Emits nothing itself; spans opened inside
+        resolve their parent from the carried marker."""
+        if ref is None or not self._active:
+            yield
+            return
+        stack = self._local.__dict__.setdefault("stack", [])
+        marker = _CarriedRef(ref)
+        stack.append(marker)
+        try:
+            yield
+        finally:
+            if stack and stack[-1] is marker:
+                stack.pop()
+            elif marker in stack:  # unbalanced inner exits: drop self only
+                stack.remove(marker)
 
     # -- event stream ------------------------------------------------------
     def _emit(self, span: Span, dur: float) -> None:

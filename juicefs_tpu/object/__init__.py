@@ -13,10 +13,24 @@ from __future__ import annotations
 
 from typing import Callable
 
-from .interface import Obj, ObjectStorage, NotFoundError
+from .interface import (
+    Obj,
+    ObjectStorage,
+    NotFoundError,
+    PermanentError,
+    ThrottleError,
+)
 from .file import FileStorage
 from .mem import MemStorage
 from .metered import MeteredStorage, metered
+from .resilient import (
+    BreakerOpenError,
+    CircuitBreaker,
+    DeadlineExceeded,
+    ResilientStorage,
+    RetryPolicy,
+    resilient,
+)
 from .prefix import with_prefix
 from .sharding import sharded
 from .checksum import new_checksummed, crc32c
@@ -97,12 +111,20 @@ __all__ = [
     "Obj",
     "ObjectStorage",
     "NotFoundError",
+    "PermanentError",
+    "ThrottleError",
     "FileStorage",
     "MemStorage",
     "create_storage",
     "register",
     "metered",
     "MeteredStorage",
+    "resilient",
+    "ResilientStorage",
+    "RetryPolicy",
+    "CircuitBreaker",
+    "BreakerOpenError",
+    "DeadlineExceeded",
     "with_prefix",
     "sharded",
     "new_checksummed",

@@ -2,10 +2,12 @@
 
 The reference leans on external chaos tooling (chaos.yml workflows); this
 wrapper makes failure drills first-class and hermetic: wrap any store
-with configurable error rates, added latency, and short reads, then run
-real workloads through it and assert the recovery invariants (upload
-retry/backoff, writeback staging replay, sync convergence, no torn
-blocks). Deterministic given a seed, so failures reproduce.
+with configurable error rates, added latency, short reads, hangs (ops
+that never return) and throttle responses, then run real workloads
+through it and assert the recovery invariants (upload retry/backoff,
+deadline abandonment, breaker trips, writeback staging replay, sync
+convergence, no torn blocks). Deterministic given a seed, so failures
+reproduce.
 
 Wrap programmatically:
 
@@ -13,6 +15,14 @@ Wrap programmatically:
     ...
     store.fault_config(error_rate=0.0)   # heal mid-test
     store.counters                       # injected-fault accounting
+
+Scripted timelines (ISSUE 3: deterministic outage → heal drills for the
+deadline / breaker / half-open-probe invariants):
+
+    store.fault_schedule([
+        (0.5, dict(error_rate=1.0)),     # 0.5s of total outage...
+        (None, dict(error_rate=0.0)),    # ...then healed forever
+    ])
 """
 
 from __future__ import annotations
@@ -20,13 +30,18 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Iterator
+from typing import Iterator, Optional, Sequence
 
-from .interface import Obj, ObjectStorage
+from .interface import Obj, ObjectStorage, ThrottleError
 
 
 class InjectedFault(IOError):
     """Deliberate failure from FaultyStore (distinct from real errors)."""
+
+
+class InjectedThrottle(InjectedFault, ThrottleError):
+    """Deliberate throttle response — classified THROTTLE by the
+    resilience layer (longer backoff + concurrency shed)."""
 
 
 class FaultyStore(ObjectStorage):
@@ -36,6 +51,11 @@ class FaultyStore(ObjectStorage):
     get_error_rate / put_error_rate   per-op overrides (None = error_rate)
     latency       seconds added to every op (simulates a slow backend)
     short_reads   probability that get() returns a truncated payload
+    throttle_rate probability that an op raises InjectedThrottle
+    hang_rate     probability that an op blocks for hang_seconds (a hung
+                  backend call; healing releases current hangers early)
+    hang_seconds  how long a hung op blocks (default: effectively forever
+                  at drill scale — only deadline abandonment rescues it)
     """
 
     _KEEP = object()  # fault_config sentinel: leave the setting unchanged
@@ -44,20 +64,31 @@ class FaultyStore(ObjectStorage):
                  get_error_rate: float | None = None,
                  put_error_rate: float | None = None,
                  latency: float = 0.0, short_reads: float = 0.0,
+                 throttle_rate: float = 0.0,
+                 hang_rate: float = 0.0, hang_seconds: float = 300.0,
                  seed: int = 0):
         self._s = store
         self._rng = random.Random(seed)
         self._mu = threading.Lock()
-        self.counters = {"errors": 0, "short_reads": 0, "delayed": 0}
+        self.counters = {"errors": 0, "short_reads": 0, "delayed": 0,
+                         "throttles": 0, "hangs": 0}
         self.error_rate = error_rate
         self.get_error_rate = get_error_rate
         self.put_error_rate = put_error_rate
         self.latency = latency
         self.short_reads = short_reads
+        self.throttle_rate = throttle_rate
+        self.hang_rate = hang_rate
+        self.hang_seconds = hang_seconds
+        self._hang_release = threading.Event()
+        self._schedule: Optional[list[tuple[Optional[float], dict]]] = None
+        self._schedule_t0 = 0.0
+        self._schedule_phase = -1
 
     def fault_config(self, error_rate=_KEEP, get_error_rate=_KEEP,
                      put_error_rate=_KEEP, latency=_KEEP,
-                     short_reads=_KEEP) -> None:
+                     short_reads=_KEEP, throttle_rate=_KEEP,
+                     hang_rate=_KEEP, hang_seconds=_KEEP) -> None:
         """Reconfigure live (drills heal or worsen the store mid-run).
         Unspecified settings KEEP their current values — a partial call
         never silently resets the rest of the fault profile."""
@@ -71,13 +102,75 @@ class FaultyStore(ObjectStorage):
             self.latency = latency
         if short_reads is not self._KEEP:
             self.short_reads = short_reads
+        if throttle_rate is not self._KEEP:
+            self.throttle_rate = throttle_rate
+        if hang_seconds is not self._KEEP:
+            self.hang_seconds = hang_seconds
+        if hang_rate is not self._KEEP:
+            self.hang_rate = hang_rate
+            # healing (or re-arming) a hang profile releases everything
+            # currently stuck — drills must not wait out stale hangs
+            self._hang_release.set()
+            self._hang_release = threading.Event()
+
+    # -- scripted fault timelines ------------------------------------------
+    def fault_schedule(
+        self, phases: Sequence[tuple[Optional[float], dict]]
+    ) -> None:
+        """Apply a timeline of fault profiles: each (duration, config)
+        phase holds for `duration` seconds; a None duration (typically the
+        last phase) holds forever. Phase configs are fault_config kwargs.
+        The clock starts NOW; every op evaluates the timeline before its
+        fault roll, so outage→heal sequences are reproducible without a
+        driver thread."""
+        self._schedule = [(d, dict(cfg)) for d, cfg in phases]
+        self._schedule_t0 = time.monotonic()
+        self._schedule_phase = -1
+        self._tick_schedule()
+
+    def _tick_schedule(self) -> None:
+        sched = self._schedule
+        if sched is None:
+            return
+        elapsed = time.monotonic() - self._schedule_t0
+        idx, acc = len(sched) - 1, 0.0
+        for i, (dur, _cfg) in enumerate(sched):
+            if dur is None or elapsed < acc + dur:
+                idx = i
+                break
+            acc += dur
+        with self._mu:
+            # phases only ADVANCE: a preempted thread that computed an
+            # older phase must not re-apply an outage a newer thread
+            # already healed (the drills' determinism depends on it)
+            if idx <= self._schedule_phase:
+                return
+            self._schedule_phase = idx
+        self.fault_config(**sched[idx][1])
 
     # -- fault engine -------------------------------------------------------
     def _maybe_fail(self, op: str, rate: float | None) -> None:
+        self._tick_schedule()
         if self.latency > 0:
             with self._mu:
                 self.counters["delayed"] += 1
             time.sleep(self.latency)
+        if self.hang_rate > 0:
+            with self._mu:
+                hang = self._rng.random() < self.hang_rate
+                if hang:
+                    self.counters["hangs"] += 1
+                release = self._hang_release
+            if hang:
+                release.wait(self.hang_seconds)
+                raise InjectedFault(f"injected {op} hang (released)")
+        if self.throttle_rate > 0:
+            with self._mu:
+                throttled = self._rng.random() < self.throttle_rate
+                if throttled:
+                    self.counters["throttles"] += 1
+            if throttled:
+                raise InjectedThrottle(f"injected {op} throttle")
         r = self.error_rate if rate is None else rate
         if r > 0:
             with self._mu:

@@ -27,7 +27,15 @@ import xml.etree.ElementTree as ET
 from typing import Iterator, Optional
 
 from ..utils import get_logger
-from .interface import MultipartUpload, NotFoundError, Obj, ObjectStorage, Part
+from .interface import (
+    MultipartUpload,
+    NotFoundError,
+    Obj,
+    ObjectStorage,
+    Part,
+    PermanentError,
+    ThrottleError,
+)
 
 logger = get_logger("object.s3")
 
@@ -221,10 +229,23 @@ class S3Storage(ObjectStorage):
 
     @staticmethod
     def _check(status: int, data: bytes, key: str) -> None:
+        """Classified failures for the resilience layer (object/resilient):
+        throttle responses back off longer + shed concurrency; other 4xx
+        are permanent (the request is wrong, not unlucky) and are never
+        retried.  Every raise carries `.status` for generic classifiers."""
         if status == 404:
             raise NotFoundError(key)
         if status >= 300:
-            raise IOError(f"s3 request failed ({status}): {data[:200]!r}")
+            if status in (429, 503):  # 503 = S3 SlowDown
+                e: IOError = ThrottleError(
+                    f"s3 throttled ({status}): {data[:200]!r}")
+            elif 400 <= status < 500 and status not in (408, 416):
+                e = PermanentError(
+                    f"s3 request rejected ({status}): {data[:200]!r}")
+            else:
+                e = IOError(f"s3 request failed ({status}): {data[:200]!r}")
+            e.status = status
+            raise e
 
     def _k(self, key: str) -> str:
         return self.prefix + key

@@ -122,7 +122,25 @@ def sharded_scan_many(mesh: Mesh):
 
 
 def shard_batch(mesh: Mesh, words, lane_counts, lengths):
-    """Device_put a packed batch with the scan step's input shardings."""
+    """Device_put a packed batch with the scan step's input shardings.
+
+    Ragged batches (B not divisible by the data axis — the tail of any
+    real scan) are padded by repeating the LAST block: padded rows are
+    valid hash inputs, and because they duplicate an earlier block they
+    can only mark THEMSELVES as duplicates — dup_mask/first_idx for the
+    original rows are unchanged.  Callers slice outputs back to their
+    input length (`digests[:B]`, `dup[:B]`).
+    """
+    n_data = mesh.shape["data"]
+    b = int(words.shape[0])
+    pad = (-b) % n_data
+    if pad:
+        words = np.concatenate(
+            [np.asarray(words)] + [np.asarray(words[-1:])] * pad, axis=0)
+        lane_counts = np.concatenate(
+            [np.asarray(lane_counts)] + [np.asarray(lane_counts[-1:])] * pad)
+        lengths = np.concatenate(
+            [np.asarray(lengths)] + [np.asarray(lengths[-1:])] * pad)
     ws = NamedSharding(mesh, P("data", "lane", None, None))
     bs = NamedSharding(mesh, P("data"))
     return (

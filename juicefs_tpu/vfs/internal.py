@@ -1,6 +1,6 @@
 """Internal virtual files (reference pkg/vfs/internal.go:78-105).
 
-Five virtual inodes live at the volume root, invisible to readdir:
+Six virtual inodes live at the volume root, invisible to readdir:
 
   .control    write a JSON command, read back streamed JSON result
               (reference writes binary op+args and reads progress
@@ -12,6 +12,9 @@ Five virtual inodes live at the volume root, invisible to readdir:
               spans materialize only while open, like .accesslog
   .stats      point-in-time Prometheus text dump of the registry
   .config     the volume's runtime VFSConfig + Format as JSON
+  .status     object-plane health JSON: breaker state / degradation
+              ladder rung, retry/hedge/abandon counters, staging backlog
+              (object/resilient.py; surfaced by `juicefs status`)
 
 Inode numbers sit at the top of the 31-bit space like the reference's
 (internal.go MinInternalNode), far above allocated inodes.
@@ -32,7 +35,8 @@ LOG_INO = 0x7FFFFFFE
 STATS_INO = 0x7FFFFFFD
 CONFIG_INO = 0x7FFFFFFC
 TRACE_INO = 0x7FFFFFFB
-MIN_INTERNAL_INO = TRACE_INO
+STATUS_INO = 0x7FFFFFFA
+MIN_INTERNAL_INO = STATUS_INO
 
 INTERNAL_NAMES = {
     b".control": CONTROL_INO,
@@ -40,6 +44,7 @@ INTERNAL_NAMES = {
     b".stats": STATS_INO,
     b".config": CONFIG_INO,
     b".trace": TRACE_INO,
+    b".status": STATUS_INO,
 }
 
 
@@ -198,8 +203,30 @@ class InternalFiles:
             if self.vfs.fmt is not None:
                 conf["format"] = json.loads(self.vfs.fmt.remove_secret().to_json())
             self._bufs[fh] = json.dumps(conf, indent=2).encode()
+        elif ino == STATUS_INO:
+            self._bufs[fh] = json.dumps(self._status_payload(), indent=2,
+                                        default=str).encode()
         else:
             self._bufs[fh] = b""
+
+    def _status_payload(self) -> dict:
+        """Object-plane health for `.status` / `juicefs status`: which
+        ladder rung the mount is on, breaker state, resilience activity,
+        and the writeback/degraded staging backlog."""
+        from ..object.resilient import resilience_snapshot
+
+        store = self.vfs.store
+        health = getattr(store.storage, "health", None)
+        with store._pending_lock:
+            staged_blocks = len(store._pending_staged)
+            staged_bytes = sum(len(v) for v in store._pending_staged.values())
+        return {
+            "object_plane": health() if callable(health) else {
+                "resilient": False},
+            "degraded": bool(getattr(store, "degraded", False)),
+            "staging": {"blocks": staged_blocks, "bytes": staged_bytes},
+            "resilience_counters": resilience_snapshot(),
+        }
 
     def read(self, ino: int, fh: int, off: int, size: int) -> tuple[int, bytes]:
         if ino == LOG_INO:

@@ -10,12 +10,22 @@ recovery invariants (VERDICT r3 #8; reference analog chaos.yml +
   4. writeback upload outage — staged blocks survive the storm, serve
                       reads, and replay on recovery
   5. sync over a flaky destination — converges byte-identical
+  6. hung GETs      — a backend call that never returns is abandoned at
+                      its deadline and retried; no pinned worker threads
+  7. brownout       — hangs + throttle errors; hedged GETs bound the
+                      tail, readback exact (ISSUE 3)
+  8. blackout       — mid-workload total outage; breaker trips (and is
+                      observable via `.status`), cached reads serve with
+                      ZERO backend calls, writes degrade to staging and
+                      replay byte-identical after heal (ISSUE 3)
 """
 
 from __future__ import annotations
 
+import json
 import os
 import random
+import threading
 import time
 
 import pytest
@@ -25,6 +35,12 @@ from juicefs_tpu.meta import Format, new_client
 from juicefs_tpu.meta.context import Context
 from juicefs_tpu.object import create_storage
 from juicefs_tpu.object.fault import FaultyStore, InjectedFault
+from juicefs_tpu.object.interface import ObjectStorage
+from juicefs_tpu.object.resilient import (
+    BreakerState,
+    CircuitBreaker,
+    RetryPolicy,
+)
 from juicefs_tpu.vfs import ROOT_INO, VFS
 
 CTX = Context(uid=0, gid=0, pid=1)
@@ -233,3 +249,262 @@ def test_sync_converges_over_flaky_destination(tmp_path):
     got = {o.key: bytes(inner_dst.get(o.key)) for o in inner_dst.list_all("")}
     assert got == want, "sync never converged over the flaky destination"
     assert dst.counters["errors"] > 0
+
+
+# -- ISSUE 3: object-plane resilience drills ---------------------------------
+
+class _CallCounter(ObjectStorage):
+    """Counts every DATA call (get/put/delete) that reaches the backend
+    stack below the resilience layer — the blackout drill asserts ZERO of
+    these while the breaker is open.  HEAD is tracked separately: the
+    breaker's half-open recovery probes are sentinel HEADs and are the one
+    backend touch an open circuit is SUPPOSED to make."""
+
+    def __init__(self, inner):
+        self._s = inner
+        self.calls = 0
+        self.head_calls = 0
+        self._mu = threading.Lock()
+
+    def _tick(self):
+        with self._mu:
+            self.calls += 1
+
+    def string(self):
+        return self._s.string()
+
+    def create(self):
+        self._s.create()
+
+    def get(self, key, off=0, limit=-1):
+        self._tick()
+        return self._s.get(key, off, limit)
+
+    def put(self, key, data):
+        self._tick()
+        self._s.put(key, data)
+
+    def delete(self, key):
+        self._tick()
+        self._s.delete(key)
+
+    def head(self, key):
+        with self._mu:
+            self.head_calls += 1
+        return self._s.head(key)
+
+    def list_all(self, prefix="", marker=""):
+        self._tick()
+        return self._s.list_all(prefix, marker)
+
+
+def _counter_value(name, *labels):
+    from juicefs_tpu.metric import global_registry
+
+    m = global_registry()._metrics[name]
+    return (m.labels(*labels) if labels else m).value
+
+
+def test_hung_get_abandoned_at_deadline_and_retried():
+    """A GET that never returns must be abandoned at its attempt bound and
+    retried — the download path finishes fast and no pool worker stays
+    pinned (the autouse thread-leak guard enforces the latter)."""
+    inner = create_storage("mem://")
+    faulty = FaultyStore(inner, seed=5)
+    store = CachedStore(faulty, ChunkConfig(
+        block_size=1 << 16, hedge=False,
+        retry_policy=RetryPolicy(deadline=6.0, max_attempts=5,
+                                 attempt_timeout=0.2, base=0.001, jitter=0.0),
+        breaker=CircuitBreaker(backend="hung-get", min_samples=1000,
+                               probe_interval=999.0)))
+    try:
+        blob = os.urandom(1 << 16)
+        w = store.new_writer(31)
+        w.write_at(blob, 0)
+        w.finish(len(blob))
+        from juicefs_tpu.chunk.mem_cache import MemCache
+
+        store.cache = MemCache(0)  # force a backend GET
+        a0 = _counter_value("juicefs_object_deadline_abandoned", "GET")
+        # scripted outage: every op hangs "forever" for 0.45s of wall
+        # time, then the store heals — attempts 1-3 are abandoned at
+        # their 0.2s bound, the first post-heal attempt succeeds
+        faulty.fault_schedule([
+            (0.45, dict(hang_rate=1.0, hang_seconds=60.0)),
+            (None, dict(hang_rate=0.0)),
+        ])
+        t0 = time.perf_counter()
+        got = store.new_reader(31, len(blob)).read(0, len(blob))
+        took = time.perf_counter() - t0
+        assert bytes(got) == blob
+        assert took < 3.0, f"hung GET was not abandoned ({took:.2f}s)"
+        assert _counter_value("juicefs_object_deadline_abandoned",
+                              "GET") > a0
+        assert faulty.counters["hangs"] >= 1
+    finally:
+        faulty.fault_config(hang_rate=0.0)  # release any parked hangers
+        store.close()
+
+
+def test_brownout_hedged_gets_bound_tail_latency():
+    """Brownout: a slice of ops hang and a slice throttle.  Hedged GETs +
+    deadline abandonment keep every read far below the hang duration, all
+    bytes come back exact, and the per-class retry counters show throttle
+    handled as its own class."""
+    inner = create_storage("mem://")
+    faulty = FaultyStore(inner, seed=21)
+    store = CachedStore(faulty, ChunkConfig(
+        block_size=1 << 16, hedge=True, hedge_delay=0.05,
+        retry_policy=RetryPolicy(deadline=10.0, max_attempts=6,
+                                 attempt_timeout=0.5, base=0.001,
+                                 throttle_base=0.01, jitter=0.0),
+        breaker=CircuitBreaker(backend="brownout", min_samples=1000,
+                               probe_interval=999.0)))
+    try:
+        rng = random.Random(3)
+        slices = {}
+        for sid in range(40, 46):
+            blob = rng.randbytes(3 * (1 << 16))
+            w = store.new_writer(sid)
+            w.write_at(blob, 0)
+            w.finish(len(blob))
+            slices[sid] = blob
+        from juicefs_tpu.chunk.mem_cache import MemCache
+
+        store.cache = MemCache(0)  # every read goes to the backend
+        backend = store.storage.metric_backend  # hedge counters' label
+        h0 = _counter_value("juicefs_object_hedged_requests", backend)
+        th0 = _counter_value("juicefs_object_retries_by_class", "throttle")
+        # throttle_rate high enough that several PRIMARY attempts throttle
+        # (a throttle losing a hedged race is absorbed without a retry —
+        # correct, but then it would never show up in the class counters)
+        faulty.fault_config(hang_rate=0.2, hang_seconds=30.0,
+                            throttle_rate=0.35)
+        worst = 0.0
+        for sid, blob in slices.items():
+            t0 = time.perf_counter()
+            got = store.new_reader(sid, len(blob)).read(0, len(blob))
+            worst = max(worst, time.perf_counter() - t0)
+            assert bytes(got) == blob, f"torn data in slice {sid}"
+        # p100 stays far below the 30s hang: hedges + abandonment win
+        assert worst < 5.0, f"brownout tail not bounded ({worst:.2f}s)"
+        assert _counter_value("juicefs_object_hedged_requests",
+                              backend) > h0, "no hedges were issued"
+        assert _counter_value("juicefs_object_retries_by_class",
+                              "throttle") > th0, "no throttle retries seen"
+        assert faulty.counters["hangs"] > 0
+        assert faulty.counters["throttles"] > 0
+    finally:
+        faulty.fault_config(hang_rate=0.0, throttle_rate=0.0)
+        store.close()
+
+
+def test_blackout_breaker_ladder_and_replay(tmp_path):
+    """Total mid-workload outage: the breaker trips (observable through
+    `.status`), cache-hit reads return correct bytes with ZERO backend
+    calls, cache misses fail fast with EIO, writes degrade to forced
+    writeback staging without touching the backend, and after heal the
+    replay converges byte-identical."""
+    inner = create_storage("mem://")
+    faulty = FaultyStore(inner, seed=13)
+    calls = _CallCounter(faulty)
+    br = CircuitBreaker(backend="blackout", threshold=0.5, min_samples=4,
+                        probe_interval=0.05)
+    v, store = _mkvfs(
+        calls, block_size=1 << 16, max_retries=2, hedge=False,
+        retry_policy=RetryPolicy(deadline=5.0, max_attempts=2, base=0.001,
+                                 jitter=0.0),
+        breaker=br)
+    rng = random.Random(9)
+    try:
+        blob_a = rng.randbytes(150_000)  # warm file: served during outage
+        blob_b = rng.randbytes(100_000)  # evicted file: EIO during outage
+        st, ino_a, _, fh_a = v.create(CTX, ROOT_INO, b"a.bin", 0o644)
+        v.write(CTX, ino_a, fh_a, 0, blob_a)
+        assert v.flush(CTX, ino_a, fh_a) == 0
+        st, ino_b, _, fh_b = v.create(CTX, ROOT_INO, b"b.bin", 0o644)
+        v.write(CTX, ino_b, fh_b, 0, blob_b)
+        assert v.flush(CTX, ino_b, fh_b) == 0
+        store.flush_all()
+        st, got = v.read(CTX, ino_a, fh_a, 0, len(blob_a))  # warm the cache
+        assert st == 0 and bytes(got) == blob_a
+
+        # ---- outage + trip: cold reads of an evicted file burn failures
+        faulty.fault_config(error_rate=1.0)
+        st, slices_b = v.meta.read_chunk(ino_b, 0)
+        for s in slices_b:
+            if s.id:
+                store.evict_cache(s.id, s.size)
+        for _ in range(3):
+            if br.state == BreakerState.OPEN:
+                break
+            with pytest.raises(OSError):
+                v.read(CTX, ino_b, fh_b, 0, len(blob_b))
+        assert br.state == BreakerState.OPEN
+        assert store.degraded
+        trips = _counter_value("juicefs_object_breaker_trips", "blackout")
+        assert trips >= 1
+
+        # ---- observable through the .status internal file
+        from juicefs_tpu.vfs.internal import STATUS_INO
+
+        v.internal.open(STATUS_INO, 991)
+        st, raw = v.internal.read(STATUS_INO, 991, 0, 1 << 20)
+        v.internal.release(STATUS_INO, 991)
+        status = json.loads(bytes(raw))
+        assert status["degraded"] is True
+        assert status["object_plane"]["breaker"]["state"] == "open"
+
+        # ---- rung 1: cached reads serve exact bytes, ZERO backend calls
+        time.sleep(0.1)  # let any in-flight prefetch settle
+        c0 = calls.calls
+        for off in (0, 70_000, 130_000):
+            st, got = v.read(CTX, ino_a, fh_a, off, 10_000)
+            assert st == 0
+            assert bytes(got) == blob_a[off:off + 10_000]
+        time.sleep(0.1)  # a stray prefetch would land here — none may
+        assert calls.calls == c0, "backend was called during open breaker"
+
+        # ---- rung 3: cache misses fail FAST with EIO (no hang)
+        t0 = time.perf_counter()
+        with pytest.raises(OSError) as ei:
+            v.read(CTX, ino_b, fh_b, 0, 4096)
+        assert time.perf_counter() - t0 < 0.5, "EIO path was not fail-fast"
+        assert ei.value.errno == 5  # EIO
+        assert calls.calls == c0
+
+        # ---- rung 2: writes degrade to forced staging, zero backend calls
+        blob_c = rng.randbytes(120_000)
+        st, ino_c, _, fh_c = v.create(CTX, ROOT_INO, b"c.bin", 0o644)
+        v.write(CTX, ino_c, fh_c, 0, blob_c)
+        assert v.flush(CTX, ino_c, fh_c) == 0, "degraded write must ack"
+        assert calls.calls == c0, "degraded write touched the backend"
+        with store._pending_lock:
+            assert store._pending_staged, "nothing was staged"
+        # staged data serves reads during the outage
+        st, got = v.read(CTX, ino_c, fh_c, 5_000, 20_000)
+        assert st == 0 and bytes(got) == blob_c[5_000:25_000]
+
+        # ---- heal: probes close the breaker, reset replays staging
+        faulty.fault_config(error_rate=0.0)
+        deadline = time.time() + 8.0
+        while br.state != BreakerState.CLOSED and time.time() < deadline:
+            time.sleep(0.05)
+        assert br.state == BreakerState.CLOSED
+        assert _counter_value("juicefs_object_breaker_resets",
+                              "blackout") >= 1
+        store.flush_all(timeout=10.0)
+        with store._pending_lock:
+            assert not store._pending_staged
+
+        # ---- converged: cold readback is byte-identical for every file
+        from juicefs_tpu.chunk.mem_cache import MemCache
+
+        store.cache = MemCache(0)
+        for ino, fh, blob in ((ino_a, fh_a, blob_a), (ino_b, fh_b, blob_b),
+                              (ino_c, fh_c, blob_c)):
+            st, got = v.read(CTX, ino, fh, 0, len(blob))
+            assert st == 0 and bytes(got) == blob
+    finally:
+        v.close()
+        store.close()

@@ -147,3 +147,34 @@ def test_sharded_scan_matches_reference():
     hdup, hfirst = dedup_digests(ref)
     assert list(np.asarray(dup)) == list(hdup)
     assert list(np.asarray(first)) == list(hfirst)
+
+
+def test_sharded_scan_ragged_batch_pads_and_matches_reference():
+    """A batch NOT divisible by the data axis (the tail of any real scan):
+    shard_batch pads by repeating the last block; outputs sliced back to
+    the input length are byte-identical to the reference (VERDICT r4 #9)."""
+    import jax
+
+    from juicefs_tpu.tpu.sharding import make_mesh, shard_batch, sharded_scan_step
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices (conftest sets XLA_FLAGS)")
+    mesh = make_mesh(n_data=4, n_lane=2)
+    n = 11  # 11 % 4 == 3: ragged tail
+    sizes = [100 + 37 * i for i in range(n - 1)] + [3 * LANE_BYTES]
+    blocks = _blocks(seed=11, sizes=sizes)
+    blocks[9] = blocks[2]  # cross-shard duplicate
+    ref = [jth256(b) for b in blocks]
+    words, counts, lengths = pack_blocks(blocks, pad_lanes=4)
+    assert words.shape[0] % 4 != 0
+    step = sharded_scan_step(mesh)
+    digests, dup, first = step(*shard_batch(mesh, words, counts, lengths))
+    from juicefs_tpu.tpu.jth256 import digests_to_bytes
+
+    assert digests_to_bytes(np.asarray(digests))[:n] == ref
+    hdup, hfirst = dedup_digests(ref)
+    assert list(np.asarray(dup))[:n] == list(hdup)
+    assert list(np.asarray(first))[:n] == list(hfirst)
+    # padded rows duplicate the final block, so they may only ever mark
+    # THEMSELVES as duplicates — never perturb an original row
+    assert all(np.asarray(dup)[n:])

@@ -25,7 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def _populate_registry() -> None:
     """Import the modules whose metrics register at import time, and the
     runtime registrations that are cheap to trigger."""
-    import juicefs_tpu.chunk.cached_store   # noqa: F401  retries counter
+    import juicefs_tpu.chunk.cached_store   # noqa: F401  staging gauges
     import juicefs_tpu.chunk.disk_cache     # noqa: F401  disk tier counters
     import juicefs_tpu.chunk.mem_cache      # noqa: F401  cache hit/miss/evict
     import juicefs_tpu.chunk.parallel       # noqa: F401  fetch_inflight gauge
@@ -33,6 +33,7 @@ def _populate_registry() -> None:
     import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
     import juicefs_tpu.metric.trace         # noqa: F401  stage rollup histogram
     import juicefs_tpu.object.metered       # noqa: F401  per-backend op meters
+    import juicefs_tpu.object.resilient     # noqa: F401  retry/hedge/breaker
     import juicefs_tpu.object.sharding      # noqa: F401  shard routing counter
     import juicefs_tpu.tpu.pipeline         # noqa: F401  batch metrics
     from juicefs_tpu.metric import register_process_metrics
@@ -61,15 +62,63 @@ def lint(registry=None) -> list[str]:
     return problems
 
 
+def lint_resilience(root: str | None = None) -> list[str]:
+    """Sibling check (ISSUE 3): every `create_storage` consumer inside the
+    package must reach the backend through the resilience wrapper — either
+    it wraps the store itself (`resilient(...)`) or it hands the store to
+    `CachedStore`/`build_store`, which wrap internally.  A module that
+    opens a bare store and talks to the backend directly has no deadline,
+    no classified retries, and no breaker — exactly the improvised fault
+    handling this layer replaced."""
+    import ast
+
+    root = root or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "juicefs_tpu",
+    )
+    problems: list[str] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel.split(os.sep, 1)[0] == "object":
+                continue  # the wrapper layer itself
+            with open(path) as f:
+                src = f.read()
+            if "create_storage" not in src:
+                continue
+            # AST-level on both sides: bare-store detection AND coverage
+            # must be real CALLS — a docstring or comment mentioning
+            # "CachedStore(" must not satisfy the check
+            called = {
+                getattr(node.func, "id", None) or getattr(node.func, "attr", None)
+                for node in ast.walk(ast.parse(src))
+                if isinstance(node, ast.Call)
+            }
+            if "create_storage" not in called:
+                continue
+            covered = called & {"resilient", "CachedStore", "build_store"}
+            if not covered:
+                problems.append(
+                    f"juicefs_tpu/{rel}: create_storage() result never "
+                    "passes through the resilience wrapper (use "
+                    "resilient(...) or CachedStore/build_store)"
+                )
+    return problems
+
+
 def main() -> int:
-    problems = lint()
+    problems = lint() + lint_resilience()
     if problems:
         for p in problems:
             print(f"lint_metrics: {p}", file=sys.stderr)
         return 1
     from juicefs_tpu.metric import global_registry
 
-    print(f"lint_metrics: {len(global_registry().walk())} metrics OK")
+    print(f"lint_metrics: {len(global_registry().walk())} metrics OK "
+          "(+ resilience wiring clean)")
     return 0
 
 

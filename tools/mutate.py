@@ -47,8 +47,13 @@ TEST_MAP = {
     "juicefs_tpu/vfs/cache": ["tests/test_vfs.py", "tests/test_fuse.py"],
     "juicefs_tpu/vfs/reader": ["tests/test_vfs.py", "tests/test_fsx.py"],
     "juicefs_tpu/vfs/writer": ["tests/test_vfs.py", "tests/test_fsx.py"],
-    "juicefs_tpu/chunk/cached_store": ["tests/test_chunk.py"],
+    "juicefs_tpu/chunk/cached_store": ["tests/test_chunk.py",
+                                       "tests/test_chaos.py"],
     "juicefs_tpu/chunk/disk_cache": ["tests/test_chunk.py"],
+    "juicefs_tpu/object/resilient": ["tests/test_resilient.py",
+                                     "tests/test_chaos.py"],
+    "juicefs_tpu/object/fault": ["tests/test_resilient.py",
+                                 "tests/test_chaos.py"],
     "juicefs_tpu/tpu/jth256": ["tests/test_tpu_hash.py"],
 }
 DEFAULT_TESTS = ["tests/test_meta.py", "tests/test_vfs.py"]
