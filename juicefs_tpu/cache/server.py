@@ -1,0 +1,194 @@
+"""Peer block server: serve the local block cache to cache-group peers.
+
+A deliberately tiny read-only HTTP surface (stdlib http.server, JSON/raw
+bytes — the same dependency posture as the sync cluster manager):
+
+    GET  /block/{key}   raw cached block bytes; 404 when not cached
+    HEAD /block/{key}   presence probe: size + digest headers, no body
+    GET  /ring          membership/identity snapshot (debugging, and the
+                        target of peer-breaker half-open probes)
+
+Every block response carries `X-Block-Crc32` (crc32 of the payload) so a
+client can reject a wrong-block serve during membership churn — a peer
+with a stale ring may be asked for a key it legitimately has, but a
+corrupt or mismatched payload must never enter the reader's cache.
+
+Serves from the DiskCache/MemCache raw tier AND from writeback staging
+(`_pending_staged`): a block a peer wrote but has not uploaded yet is
+exactly the block the object store cannot serve.  Strictly read-only —
+peers can never mutate each other's caches.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Server(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can hard-close its live connections.
+    Clients hold keep-alive sockets; a plain shutdown() only stops the
+    accept loop, leaving handler threads serving those sockets — a
+    stopped peer must actually go dark (tests kill it to drill the
+    fall-through path, and a real unmount must not linger)."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns: set = set()
+        self._conns_mu = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_mu:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        # normal connection teardown (miss responses send Connection:
+        # close, so peers reconnect often): forget the socket, or the
+        # tracking set grows one dead object per served connection
+        with self._conns_mu:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        with self._conns_mu:
+            conns, self._conns = list(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+from ..metric import global_registry
+from ..utils import get_logger
+
+logger = get_logger("cache.server")
+
+_reg = global_registry()
+_SERVED = _reg.counter(
+    "juicefs_cache_group_served",
+    "Peer block requests answered from the local cache",
+    ("op",),
+)
+_SERVED_BYTES = _reg.counter(
+    "juicefs_cache_group_served_bytes",
+    "Bytes served to cache-group peers from the local cache",
+)
+_SERVE_MISSES = _reg.counter(
+    "juicefs_cache_group_serve_misses",
+    "Peer block requests this node could not serve (not cached here)",
+)
+
+
+class PeerBlockServer:
+    """HTTP server exporting one CachedStore's block cache to the group."""
+
+    def __init__(self, store, group: str = ""):
+        self.store = store
+        self.group = group
+        self.addr = ""
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lookup ------------------------------------------------------------
+    def _lookup(self, key: str) -> bytes | None:
+        from ..chunk.cached_store import parse_block_key
+
+        if parse_block_key(key) is None:
+            return None  # only well-formed block keys; no path games
+        data = self.store.cache.load(key, count_miss=False)
+        if data is None:
+            with self.store._pending_lock:
+                data = self.store._pending_staged.get(key)
+        return data
+
+    def ring_view(self) -> dict:
+        group = getattr(self.store, "cache_group", None)
+        view = {"group": self.group, "addr": self.addr}
+        if group is not None:
+            view.update(ring_size=len(group.ring),
+                        members=group.ring.members)
+        return view
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, listen: str = "127.0.0.1:0") -> str:
+        """Bind + serve on a daemon thread; returns the bound host:port
+        (port 0 auto-picks, the address peers will dial)."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _block(self, send_body: bool) -> None:
+                key = self.path[len("/block/"):].split("?", 1)[0]
+                data = server._lookup(key)
+                if data is None:
+                    _SERVE_MISSES.inc()
+                    self.send_error(404)
+                    return
+                data = bytes(data)
+                _SERVED.labels("get" if send_body else "head").inc()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Block-Crc32", str(zlib.crc32(data)))
+                # echo the key the server RESOLVED: the client rejects a
+                # mismatched echo (routing mix-up = wrong-block serve)
+                self.send_header("X-Block-Key", key)
+                self.end_headers()
+                if send_body:
+                    _SERVED_BYTES.inc(len(data))
+                    self.wfile.write(data)
+
+            def _json(self, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.startswith("/block/"):
+                    self._block(send_body=True)
+                elif self.path.split("?", 1)[0] == "/ring":
+                    self._json(server.ring_view())
+                else:
+                    self.send_error(404)
+
+            def do_HEAD(self):  # noqa: N802
+                if self.path.startswith("/block/"):
+                    self._block(send_body=False)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *a):
+                pass
+
+        host, _, port = listen.rpartition(":")
+        self._httpd = _Server((host or "127.0.0.1", int(port or 0)), Handler)
+        self.addr = (f"{self._httpd.server_address[0]}:"
+                     f"{self._httpd.server_address[1]}")
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"peer-cache-{self.addr}",
+        )
+        self._thread.start()
+        logger.info("cache-group %r peer server on %s", self.group, self.addr)
+        return self.addr
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.close_all_connections()
+            self._httpd.server_close()
+            self._httpd = None

@@ -165,6 +165,9 @@ class CachedStore:
         # content indexer (chunk/indexer.py), attached by cmd.build_store
         # when the volume has a hash_backend
         self.indexer = None
+        # cache group (cache/group.py), attached by cmd/mount or tests:
+        # the peer rung between the local cache and the object store
+        self.cache_group = None
         _LIVE_STORES.add(self)
         if self.conf.writeback:
             self._recover_staging()
@@ -234,6 +237,19 @@ class CachedStore:
             if staged is not None:
                 return staged
 
+            # peer rung (ISSUE 4): the ring owner's cache, tried BEFORE
+            # the backend and regardless of the backend breaker's state —
+            # peer reads must keep serving through a backend outage.  A
+            # dead/slow peer degrades (falls through) here; it never
+            # fails the read.
+            group = self.cache_group
+            if group is not None:
+                peer_data = group.fetch(key, bsize, parent=parent)
+                if peer_data is not None:
+                    if cache_after:
+                        self.cache.cache(key, peer_data)
+                    return peer_data
+
             def fetch() -> bytes:
                 data = self.storage.get(key)
                 raw = self.compressor.decompress(data, bsize)
@@ -262,8 +278,10 @@ class CachedStore:
         """Returns True only when this call actually warmed the block
         (Prefetcher credits juicefs_prefetch_used from that)."""
         key, bsize = key_size
-        if self.degraded:
-            return False  # outage: warming would only burn EIO fast-fails
+        if self.degraded and self.cache_group is None:
+            # outage: warming would only burn EIO fast-fails (with a cache
+            # group the peer rung may still warm us, so keep trying)
+            return False
         if self.cache.load(key, count_miss=False) is None:
             try:
                 self._load_block(key, bsize)
@@ -324,12 +342,18 @@ class CachedStore:
             drop, self._rpool, self.conf.max_download,
         ))
 
-    def fill_cache(self, sid: int, length: int) -> None:
+    def fill_cache(self, sid: int, length: int, only=None) -> None:
         """Warm every block of a slice (reference vfs/fill.go FillCache);
-        loads overlap on the download pool, failures propagate."""
+        loads overlap on the download pool, failures propagate.  `only`
+        filters block keys — distributed warmup fills just the blocks this
+        member owns on the cache-group ring (cmd/warmup.py)."""
         if length > 0:
+            blocks = [
+                kb for kb in self._block_range(sid, length)
+                if only is None or only(kb[0])
+            ]
             for _ in fetch_ordered(
-                list(self._block_range(sid, length)),
+                blocks,
                 lambda kb: self._load_block(kb[0], kb[1]),
                 self._rpool, self.conf.max_download,
             ):
@@ -380,6 +404,11 @@ class CachedStore:
         if self.indexer is not None:
             try:
                 self.indexer.close()
+            except Exception:
+                pass
+        if self.cache_group is not None:
+            try:
+                self.cache_group.close()  # stop peer breaker probes
             except Exception:
                 pass
         try:  # resilience resources (probe thread, abandon pool) only —

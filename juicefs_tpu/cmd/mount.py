@@ -37,6 +37,17 @@ def add_parser(sub):
     p.add_argument("--no-hedge", action="store_true",
                    help="disable hedged GETs (tail-latency duplicate "
                         "requests after the live p95)")
+    p.add_argument("--cache-group", default="",
+                   help="join this named peer cache group: serve the local "
+                        "block cache to peers and read peers' caches before "
+                        "the object store (membership via meta sessions)")
+    p.add_argument("--group-weight", type=int, default=1,
+                   help="ring weight of this member (bigger cache => "
+                        "proportionally more of the keyspace)")
+    p.add_argument("--group-listen", default="127.0.0.1:0",
+                   help="host:port the peer block server binds (port 0 "
+                        "auto-picks; the bound address is published in the "
+                        "session info)")
     p.add_argument("--max-readahead", type=int, default=8, help="MiB")
     p.add_argument("--attr-cache", type=float, default=1.0,
                    help="attr cache TTL seconds (reference --attr-cache)")
@@ -121,10 +132,34 @@ def serve(args) -> int:
         if takeover is None:
             logger.info("no predecessor at %s; fresh mount", args.mountpoint)
     store = build_store(fmt, args, meta=m)
+    # cache group (ISSUE 4): start the peer block server BEFORE the
+    # session registers, so the published session info already carries the
+    # dialable peer_addr; discovery then rides the heartbeat cadence
+    peer_srv = None
+    if getattr(args, "cache_group", ""):
+        from ..cache import CacheGroup, PeerBlockServer
+
+        peer_srv = PeerBlockServer(store, group=args.cache_group)
+        peer_addr = peer_srv.start(getattr(args, "group_listen",
+                                           "127.0.0.1:0"))
+        m.session_extras.update(
+            cache_group=args.cache_group, peer_addr=peer_addr,
+            group_weight=max(1, getattr(args, "group_weight", 1)),
+        )
+        store.cache_group = CacheGroup(
+            args.cache_group, self_addr=peer_addr, meta=m,
+            weight=max(1, getattr(args, "group_weight", 1)),
+            refresh_interval=args.heartbeat,
+        )
+        logger.info("cache group %r: serving on %s",
+                    args.cache_group, peer_addr)
     if takeover is not None and takeover[1].get("sid"):
         # inherit the predecessor's session: locks and sustained inodes
         # keyed by sid remain valid across the swap
         m.sid = int(takeover[1]["sid"])
+        # ...but the session INFO must be ours: the predecessor's record
+        # advertises its (now dead) cache-group peer_addr/pid
+        m.update_session_info()
         m.start_heartbeat(args.heartbeat)
     else:
         m.new_session(heartbeat=args.heartbeat)
@@ -209,6 +244,8 @@ def serve(args) -> int:
             logger.info("handover complete; exiting without unmount")
             m.sid = 0  # close_session must not clean the live session
         vfs.close()
+        if peer_srv is not None:
+            peer_srv.stop()  # stop serving peers before the cache closes
         try:
             store.close()
         except Exception as e:

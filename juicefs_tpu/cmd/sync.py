@@ -83,6 +83,14 @@ def add_parser(sub):
     p.add_argument("--worker", action="store_true",
                    help="pull task batches from --manager and execute them")
     p.add_argument("--manager", default="", help="manager host:port")
+    p.add_argument("--worker-hosts", default="",
+                   help="comma-separated hosts: the manager BOOTSTRAPS one "
+                        "worker per host via --worker-launch (reference "
+                        "cluster.go:237 ssh bootstrap)")
+    p.add_argument("--worker-launch", default="",
+                   help="launch template with {host} and {cmd} placeholders "
+                        "run through the shell, e.g. 'ssh {host} {cmd}'; "
+                        "default: run {cmd} as a local subprocess")
     p.set_defaults(func=run)
 
 
@@ -323,6 +331,61 @@ def _obj_unwire(v):
     return None if v is None else Obj(key=v[0], size=v[1], mtime=v[2])
 
 
+def _launch_workers(args, addr: str, flags: list[str]) -> list:
+    """Bootstrap one worker per --worker-hosts entry (reference
+    cluster.go:237, which ssh-launches workers).  The launch template gets
+    {host} and {cmd}; the default runs {cmd} as a local subprocess — the
+    hermetic analog of `ssh localhost {cmd}` — so a single command drives
+    a whole localhost cluster end to end."""
+    import shlex
+    import subprocess
+    import sys
+
+    hosts = [h.strip() for h in
+             getattr(args, "worker_hosts", "").split(",") if h.strip()]
+    if not hosts:
+        return []
+    worker_argv = ["sync", args.src, args.dst, *flags,
+                   "--worker", "--manager", addr,
+                   "--threads", str(args.threads)]
+    template = getattr(args, "worker_launch", "")
+    procs = []
+    for host in hosts:
+        if template:
+            # remote form: the template decides the transport and the
+            # remote entrypoint; {cmd} is the bare subcommand string
+            shell_cmd = template.format(
+                host=host, cmd=shlex.join(worker_argv))
+            procs.append(subprocess.Popen(
+                shell_cmd, shell=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        else:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "juicefs_tpu.cmd", *worker_argv],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        logger.info("launched worker on %s", host)
+    return procs
+
+
+def _reap_workers(procs: list, timeout: float = 30.0) -> bool:
+    """Collect bootstrapped workers; True when any failed (nonzero exit
+    or had to be killed) — the manager must not report a clean sync."""
+    import subprocess
+
+    failed = False
+    for p in procs:
+        try:
+            rc = p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            rc = -9
+        if rc != 0:
+            logger.error("bootstrapped worker exited %s", rc)
+            failed = True
+    return failed
+
+
 def run_manager(args, tasks) -> int:
     """Serve the ordered diff as a task queue (reference startManager
     cluster.go:132); aggregate worker stats.
@@ -409,22 +472,50 @@ def run_manager(args, tasks) -> int:
                       "worker_cmd": f"sync {args.src} {args.dst} "
                                     f"{' '.join(flags)} --worker "
                                     f"--manager {addr}"}), flush=True)
+    workers = _launch_workers(args, addr, flags)
     idle_limit = 300.0
     timed_out = False
     while not done.wait(timeout=5.0):
         with lock:
             started = state["busy"] > 0 or state["dispatched"] > 0
+            busy = state["busy"]
             idle = time.monotonic() - state["last_activity"]
         if started and idle > idle_limit:
             logger.error("no worker activity for %.0fs; giving up", idle)
             timed_out = True
             break
+        if workers and busy <= 0 \
+                and all(p.poll() is not None for p in workers):
+            if not args.worker_launch:
+                # every bootstrapped worker already exited and none is
+                # still registered: nothing will ever drain the queue —
+                # fail now instead of waiting out the idle limit
+                logger.error("all bootstrapped workers exited prematurely")
+                timed_out = True
+                break
+            if state["dispatched"] == 0 \
+                    and all(p.returncode != 0 for p in workers):
+                # custom template: a detaching launcher (ssh -f, tmux)
+                # exiting 0 says nothing about the worker, so the idle
+                # limit is the backstop there — but every LAUNCH command
+                # failing outright before any work is a dead cluster
+                logger.error("every worker launch command failed")
+                timed_out = True
+                break
     httpd.shutdown()
     httpd.server_close()
+    worker_failed = _reap_workers(workers)
     # every dispatched task must come back as a completed task: a worker
-    # killed mid-batch reports fewer tasks_done than it fetched
+    # killed mid-batch reports fewer tasks_done than it fetched.  A
+    # bootstrapped worker's nonzero exit matters only when the accounting
+    # is ALSO short — a straggler that registered after a fast sibling
+    # drained the whole queue (its /register hits a closed manager) must
+    # not fail a sync whose every task completed.
     incomplete = (timed_out or not state["exhausted"]
                   or totals["tasks_done"] < state["dispatched"])
+    if worker_failed and not incomplete:
+        logger.warning("a bootstrapped worker exited nonzero after the "
+                       "sync completed (late straggler); result unaffected")
     if incomplete and not timed_out:
         logger.error(
             "workers completed %d of %d dispatched tasks — partial sync",

@@ -1,5 +1,11 @@
 """`warmup`: pre-fill the local cache for paths (reference cmd/warmup.go +
-pkg/vfs/fill.go:57-145 — walk the tree, FillCache every slice)."""
+pkg/vfs/fill.go:57-145 — walk the tree, FillCache every slice).
+
+With `--cache-group` (ISSUE 4) the fill is DISTRIBUTED: each invocation
+warms only the blocks this member owns on the group's consistent-hash
+ring, so a fleet-wide warmup moves each block from the object store
+exactly once instead of once per client — everyone else reads it from
+the owner's peer server."""
 
 from __future__ import annotations
 
@@ -15,11 +21,19 @@ def add_parser(sub):
     p.add_argument("meta_url")
     p.add_argument("paths", nargs="+", help="volume-absolute paths, e.g. /data")
     p.add_argument("--threads", type=int, default=8)
+    p.add_argument("--cache-group", default="",
+                   help="distribute the fill across this cache group's "
+                        "ring: warm only the blocks THIS member owns")
+    p.add_argument("--group-self", default="",
+                   help="peer address identifying this member on the ring "
+                        "(default: the group session on this hostname)")
     p.set_defaults(func=run)
 
 
-def fill_paths(m, store, paths: list[str], threads: int = 8) -> tuple[int, int]:
-    """Warm every slice under the given paths; returns (files, slices)."""
+def fill_paths(m, store, paths: list[str], threads: int = 8,
+               group=None) -> tuple[int, int]:
+    """Warm every slice under the given paths; returns (files, slices).
+    With `group` (a cache.CacheGroup) only ring-owned blocks are fetched."""
     from concurrent.futures import ThreadPoolExecutor
 
     files = []
@@ -58,9 +72,43 @@ def fill_paths(m, store, paths: list[str], threads: int = 8) -> tuple[int, int]:
                 continue
             tasks.extend((s.id, s.size) for s in slices if s.id)
 
+    only = group.owns if group is not None else None
     with ThreadPoolExecutor(max_workers=threads) as pool:
-        list(pool.map(lambda t: store.fill_cache(*t), tasks))
+        list(pool.map(lambda t: store.fill_cache(*t, only=only), tasks))
     return len(files), len(tasks)
+
+
+def _group_for(m, name: str, self_addr: str):
+    """Build a discovery-backed CacheGroup for a warmup run.  The warmup
+    process is not the mount, so its ring identity is the LOCAL mount's
+    published peer address — given explicitly or found by hostname."""
+    import socket
+
+    from ..cache import CacheGroup
+
+    if not self_addr:
+        import time
+
+        host = socket.gethostname()
+        now = time.time()
+        for s in m.do_list_sessions():
+            expire = getattr(s, "expire", 0.0) or 0.0
+            if (getattr(s, "cache_group", "") == name
+                    and getattr(s, "peer_addr", "")
+                    and s.hostname == host
+                    and not 0 < expire < now):  # skip stale leftovers —
+                # a dead predecessor's record must not become our identity
+                self_addr = s.peer_addr
+                break
+    if not self_addr:
+        # without a ring identity, owns() would reject EVERY key (all
+        # owners are real peers) and the warmup would silently fetch
+        # nothing — degrade to an undistributed fill-all instead
+        logger.warning(
+            "cache group %r: no member on this host (and no --group-self); "
+            "warming every block locally", name)
+        return None
+    return CacheGroup(name, self_addr=self_addr, meta=m)
 
 
 def run(args) -> int:
@@ -68,6 +116,16 @@ def run(args) -> int:
 
     m, fmt = open_meta(args.meta_url)
     store = build_store(fmt, args)
-    nfiles, nslices = fill_paths(m, store, args.paths, args.threads)
-    print(f"warmed {nfiles} files / {nslices} slices")
+    group = None
+    if args.cache_group:
+        group = _group_for(m, args.cache_group, args.group_self)
+    try:
+        nfiles, nslices = fill_paths(m, store, args.paths, args.threads,
+                                     group=group)
+    finally:
+        if group is not None:
+            group.close()
+    shard = f" (ring shard of group {args.cache_group!r})" \
+        if group is not None else ""
+    print(f"warmed {nfiles} files / {nslices} slices{shard}")
     return 0

@@ -83,6 +83,10 @@ class BaseMeta(interface.Meta):
         self._inval_mu = threading.Lock()
         self._inval_cbs: list[Callable] = []
         self._inval_seq = -1  # last peer sequence seen (-1 = from "now")
+        # extra Session fields published at new_session time (cache-group
+        # membership: cache_group / peer_addr / group_weight — ISSUE 4).
+        # Set BEFORE new_session; peers read them from do_list_sessions.
+        self.session_extras: dict = {}
 
     # -- abstract engine ops (reference base.go:51-125) --------------------
     def do_init(self, fmt: Format, force: bool) -> int: ...
@@ -186,7 +190,7 @@ class BaseMeta(interface.Meta):
     def new_session(self, record: bool = True, heartbeat: float = 0.0) -> int:
         """Register a client session (reference base.go:371 NewSession)."""
         if record:
-            self.sid = self.do_new_session(new_session_info())
+            self.sid = self.do_new_session(new_session_info(**self.session_extras))
             self.do_watch_unlocks()
             if heartbeat > 0:
                 self.start_heartbeat(heartbeat)
@@ -208,6 +212,19 @@ class BaseMeta(interface.Meta):
             target=self._session_refresher, args=(interval,), daemon=True
         )
         self._heartbeat.start()
+
+    def update_session_info(self) -> None:
+        """Re-publish this session's info record (same sid).  A takeover
+        successor adopts the predecessor's sid WITHOUT new_session, so
+        fields like the cache-group peer_addr would otherwise keep
+        advertising the dead predecessor's endpoint forever."""
+        if self.sid:
+            info = new_session_info(**self.session_extras)
+            info.sid = self.sid
+            self.do_update_session(self.sid, info)
+
+    def do_update_session(self, sid: int, info: Session) -> None:
+        """Engines overwrite the stored session info; default no-op."""
 
     def close_session(self) -> None:
         self._stop.set()

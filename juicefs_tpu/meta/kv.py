@@ -58,6 +58,7 @@ from .types import (
     RENAME_EXCHANGE,
     RENAME_NOREPLACE,
     ROOT_INODE,
+    SESSION_STALE_AGE,
     SET_ATTR_ATIME,
     SET_ATTR_ATIME_NOW,
     SET_ATTR_FLAG,
@@ -370,6 +371,10 @@ class KVMeta(BaseMeta):
     def do_refresh_session(self, sid: int) -> None:
         self.client.txn(lambda tx: tx.set(self._heartbeat_key(sid), _F64.pack(time.time())))
 
+    def do_update_session(self, sid: int, info: Session) -> None:
+        self.client.txn(lambda tx: tx.set(
+            self._session_key(sid), info.to_json().encode()))
+
     def do_clean_session(self, sid: int) -> None:
         """Release a session: reclaim sustained inodes, drop its locks
         (reference base.go:504 CleanStaleSessions / doCleanStaleSession)."""
@@ -409,15 +414,25 @@ class KVMeta(BaseMeta):
                     )
 
     def do_list_sessions(self) -> list[Session]:
+        # heartbeats ride along so consumers (status, cache-group peer
+        # discovery) can judge liveness: expire = last beat + stale age
+        beats = {
+            int.from_bytes(k[2:], "big"): _F64.unpack(v)[0]
+            for k, v in self.client.scan(b"SH", next_key(b"SH"))
+            if len(k) == 10
+        }
         out = []
         for _, v in self.client.scan(b"SE", next_key(b"SE")):
             try:
-                out.append(Session.from_json(v))
+                s = Session.from_json(v)
             except ValueError:
-                pass
+                continue
+            if s.sid in beats:
+                s.expire = beats[s.sid] + SESSION_STALE_AGE
+            out.append(s)
         return out
 
-    def clean_stale_sessions(self, age: float = 300.0) -> int:
+    def clean_stale_sessions(self, age: float = SESSION_STALE_AGE) -> int:
         """GC sessions whose heartbeat is older than `age` seconds."""
         cleaned = 0
         now = time.time()

@@ -46,6 +46,7 @@ from .types import (
     RENAME_EXCHANGE,
     RENAME_NOREPLACE,
     ROOT_INODE,
+    SESSION_STALE_AGE,
     SET_ATTR_ATIME,
     SET_ATTR_ATIME_NOW,
     SET_ATTR_FLAG,
@@ -468,6 +469,14 @@ class SQLMeta(BaseMeta):
 
         self._txn(fn)
 
+    def do_update_session(self, sid: int, info: Session) -> None:
+        def fn(cur):
+            cur.execute("UPDATE session2 SET info=? WHERE sid=?",
+                        (info.to_json(), sid))
+            return 0
+
+        self._txn(fn)
+
     def do_clean_session(self, sid: int) -> None:
         sustained = self._rtxn(lambda cur: [
             r[0] for r in cur.execute(
@@ -487,17 +496,21 @@ class SQLMeta(BaseMeta):
 
     def do_list_sessions(self) -> list[Session]:
         rows = self._rtxn(lambda cur: cur.execute(
-            "SELECT info FROM session2 ORDER BY sid"
+            "SELECT info, heartbeat FROM session2 ORDER BY sid"
         ).fetchall())
         out = []
-        for (info,) in rows:
+        for info, heartbeat in rows:
             try:
-                out.append(Session.from_json(info))
+                s = Session.from_json(info)
             except ValueError:
-                pass
+                continue
+            # liveness for status / cache-group discovery (same stale age
+            # as clean_stale_sessions)
+            s.expire = float(heartbeat or 0) + SESSION_STALE_AGE
+            out.append(s)
         return out
 
-    def clean_stale_sessions(self, age: float = 300.0) -> int:
+    def clean_stale_sessions(self, age: float = SESSION_STALE_AGE) -> int:
         cutoff = time.time() - age
         stale = self._rtxn(lambda cur: [
             r[0] for r in cur.execute(

@@ -36,6 +36,12 @@ ROOT_INODE = 1
 TRASH_INODE = 0x7FFFFFFF10000000
 TRASH_NAME = ".trash"
 
+# A session whose heartbeat is older than this is stale: the GC reaps it
+# and liveness consumers (status, cache-group discovery) ignore it.  ONE
+# constant — a cleaner reaping at 60s while discovery trusts beat+300s
+# would route peer reads to sessions the cleaner already killed.
+SESSION_STALE_AGE = 300.0
+
 # setattr field masks (reference pkg/meta/interface.go SetAttr* flags)
 SET_ATTR_MODE = 1 << 0
 SET_ATTR_UID = 1 << 1
@@ -302,6 +308,12 @@ class Session:
     mount_point: str = ""
     process_id: int = 0
     expire: float = 0.0
+    # cache-group membership (ISSUE 4): a mount serving its block cache
+    # to peers publishes its group, dial address, and ring weight here —
+    # peer discovery IS the session table, no extra coordination service
+    cache_group: str = ""
+    peer_addr: str = ""
+    group_weight: int = 1
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -313,7 +325,7 @@ class Session:
         return cls(**{k: v for k, v in raw.items() if k in known})
 
 
-def new_session_info(mount_point: str = "") -> Session:
+def new_session_info(mount_point: str = "", **extras) -> Session:
     import socket
 
     return Session(
@@ -321,4 +333,5 @@ def new_session_info(mount_point: str = "") -> Session:
         hostname=socket.gethostname(),
         mount_point=mount_point,
         process_id=os.getpid(),
+        **extras,
     )

@@ -220,13 +220,19 @@ class InternalFiles:
         with store._pending_lock:
             staged_blocks = len(store._pending_staged)
             staged_bytes = sum(len(v) for v in store._pending_staged.values())
-        return {
+        out = {
             "object_plane": health() if callable(health) else {
                 "resilient": False},
             "degraded": bool(getattr(store, "degraded", False)),
             "staging": {"blocks": staged_blocks, "bytes": staged_bytes},
             "resilience_counters": resilience_snapshot(),
         }
+        group = getattr(store, "cache_group", None)
+        if group is not None:
+            # ring membership + per-peer breaker state (ISSUE 4: a dead
+            # peer's open breaker must be observable here)
+            out["cache_group"] = group.health()
+        return out
 
     def read(self, ino: int, fh: int, off: int, size: int) -> tuple[int, bytes]:
         if ino == LOG_INO:

@@ -1,0 +1,154 @@
+"""Golden signature fixtures for the cloud drivers (VERDICT r4 Weak #3).
+
+The azure/gs drivers are normally proven against the in-tree emulators —
+but the Azure emulator VERIFIES with the driver's own SharedKey class, so
+a canonicalization bug would move both sides in lockstep and every test
+would stay green (co-drift).  These fixtures pin the driver's request
+canonicalization against constants derived from the PUBLISHED worked
+examples, typed into this file independently of the implementation:
+
+  - Azure SharedKey string-to-sign: the worked example in Microsoft's
+    "Authorize with Shared Key" (learn.microsoft.com/rest/api/
+    storageservices/authorize-with-shared-key, version 2015-02-21 sample,
+    account `myaccount`, `GET /mycontainer?comp=metadata`).
+  - Azure HMAC-SHA256 step: the same canonical string signed with the
+    PUBLISHED well-known emulator account key (the `devstoreaccount1`
+    key every Azure emulator ships), golden value computed once from the
+    spec's algorithm (base64(HMAC-SHA256(key, utf8(string-to-sign)))).
+  - GCS JSON-API path encoding: cloud.google.com/storage/docs/
+    request-endpoints#encoding — object names in request paths are
+    percent-encoded with NO safe characters (`foo/bar` => `foo%2Fbar`).
+
+If a refactor changes what the driver puts on the wire, these fail even
+though the emulator (sharing the bug) would happily accept it.
+"""
+
+import base64
+import hashlib
+import hmac
+
+from juicefs_tpu.object.azure import SharedKey
+from juicefs_tpu.object.gs import GSStorage
+
+# Published well-known emulator credentials (Azurite / legacy Storage
+# Emulator — documented constants, not secrets).
+DEV_ACCOUNT = "devstoreaccount1"
+DEV_KEY = ("Eby8vdM02xNOcqFlqUwJPLlmEtlCDXJ1OUzFT50uSRZ6IFsuFq2UVErCz4I6"
+           "tq/K1SZFPTOtr/KBHBeksoGMGw==")
+
+# The worked example's canonical string, typed from the doc: verb, 11
+# empty standard headers (Content-Length MUST be "" when zero), the two
+# canonicalized x-ms headers, then /account/container + one query pair.
+DOC_STRING_TO_SIGN = (
+    "GET\n\n\n\n\n\n\n\n\n\n\n\n"
+    "x-ms-date:Fri, 26 Jun 2015 23:39:12 GMT\n"
+    "x-ms-version:2015-02-21\n"
+    "/{account}/mycontainer\ncomp:metadata"
+)
+DOC_HEADERS = {
+    "x-ms-date": "Fri, 26 Jun 2015 23:39:12 GMT",
+    "x-ms-version": "2015-02-21",
+}
+
+# base64(HMAC-SHA256(DEV_KEY, string_to_sign)) computed once from the
+# spec's algorithm over the literal strings above — NOT via the driver.
+GOLDEN_SIG_MYACCOUNT = "JQD4EG61CNAVOVz6skGkqhDxPqr4KmjalvkTyrWHkaE="
+GOLDEN_SIG_DEVSTORE = "t5jT+Uxk4lOZmcJwMPjBf2kjBA5Z9VSEPdPVDlWjXXQ="
+
+
+def test_azure_string_to_sign_matches_published_example():
+    signer = SharedKey("myaccount", DEV_KEY)
+    sts = signer.string_to_sign(
+        "GET", "/mycontainer", {"comp": "metadata"}, dict(DOC_HEADERS))
+    assert sts == DOC_STRING_TO_SIGN.format(account="myaccount")
+
+
+def test_azure_zero_content_length_canonicalizes_to_empty():
+    """The spec's sharpest edge: a literal Content-Length of 0 must
+    canonicalize as the EMPTY string (2015-02-21+ behavior the worked
+    example encodes)."""
+    signer = SharedKey("myaccount", DEV_KEY)
+    headers = dict(DOC_HEADERS, **{"Content-Length": "0"})
+    sts = signer.string_to_sign("GET", "/mycontainer",
+                                {"comp": "metadata"}, headers)
+    assert sts == DOC_STRING_TO_SIGN.format(account="myaccount")
+
+
+def test_azure_signature_matches_golden_hmac():
+    for account, golden in ((("myaccount"), GOLDEN_SIG_MYACCOUNT),
+                            ((DEV_ACCOUNT), GOLDEN_SIG_DEVSTORE)):
+        signer = SharedKey(account, DEV_KEY)
+        sig = signer.signature(
+            "GET", "/mycontainer", {"comp": "metadata"}, dict(DOC_HEADERS))
+        assert sig == golden, f"SharedKey drifted for account {account}"
+
+
+def test_azure_golden_recomputes_from_spec_algorithm():
+    """Self-check of the fixtures: the goldens really are
+    base64(HMAC-SHA256(key, utf8(doc string))) — so a future editor can
+    tell a driver regression from a stale constant."""
+    key = base64.b64decode(DEV_KEY)
+    sts = DOC_STRING_TO_SIGN.format(account="myaccount").encode()
+    want = base64.b64encode(hmac.new(key, sts, hashlib.sha256).digest())
+    assert want.decode() == GOLDEN_SIG_MYACCOUNT
+
+
+def test_azure_multi_header_and_resource_ordering():
+    """Canonicalized headers are sorted lexicographically and the
+    canonicalized resource appends every query parameter lowercased and
+    sorted — pinned against the documented construction rules."""
+    signer = SharedKey("acct", DEV_KEY)
+    sts = signer.string_to_sign(
+        "PUT", "/c/blob.bin",
+        {"comp": "block", "blockid": "QUFB"},
+        {
+            "x-ms-version": "2020-10-02",
+            "x-ms-date": "Mon, 01 Jan 2024 00:00:00 GMT",
+            "x-ms-blob-type": "BlockBlob",
+            "Content-Length": "42",
+            "Content-Type": "application/octet-stream",
+        },
+    )
+    assert sts == (
+        "PUT\n\n\n42\n\napplication/octet-stream\n\n\n\n\n\n\n"
+        "x-ms-blob-type:BlockBlob\n"
+        "x-ms-date:Mon, 01 Jan 2024 00:00:00 GMT\n"
+        "x-ms-version:2020-10-02\n"
+        "/acct/c/blob.bin\nblockid:QUFB\ncomp:block"
+    )
+
+
+# -- GCS JSON API request canonicalization -----------------------------------
+
+def _gs(prefix: str = "") -> GSStorage:
+    suffix = f"/{prefix}" if prefix else ""
+    return GSStorage(f"tok@127.0.0.1:4443/bkt{suffix}")
+
+
+def test_gcs_object_path_encoding_published_examples():
+    """cloud.google.com/storage/docs/request-endpoints#encoding: object
+    names in request paths are fully percent-encoded; the doc's own
+    example is foo/bar => foo%2Fbar."""
+    gs = _gs()
+    assert gs._opath("foo/bar") == "/storage/v1/b/bkt/o/foo%2Fbar"
+    # the documented must-encode set: space, hash, question mark, etc.
+    cases = {
+        "a b": "a%20b",
+        "a#b": "a%23b",
+        "a?b": "a%3Fb",
+        "a&b": "a%26b",
+        "a+b": "a%2Bb",
+        "a=b": "a%3Db",
+        "café": "caf%C3%A9",          # UTF-8 then percent-encoded
+        "chunks/0/0/7_0_65536": "chunks%2F0%2F0%2F7_0_65536",
+    }
+    for name, enc in cases.items():
+        assert gs._opath(name) == f"/storage/v1/b/bkt/o/{enc}", name
+
+
+def test_gcs_prefix_joins_before_encoding():
+    """A volume prefix is part of the object NAME, so its slash is
+    %2F-encoded too (one object resource, not a deeper URL path)."""
+    gs = _gs("vol")
+    assert gs._k("x/y") == "vol/x/y"
+    assert gs._opath("x/y") == "/storage/v1/b/bkt/o/vol%2Fx%2Fy"

@@ -23,6 +23,22 @@ def test_global_registry_is_clean():
     assert problems == [], "\n".join(problems)
 
 
+def test_cache_group_registry_pinned():
+    """The juicefs_cache_group_* series the tests/benchmarks counter-assert
+    must all exist, and nothing else may squat under the prefix."""
+    lint = _load_lint()
+    assert lint.lint_cache_group() == []
+    # the check really bites: a missing expected series is reported
+    from juicefs_tpu.metric import Registry
+
+    reg = Registry()
+    reg.counter("juicefs_cache_group_rogue", "unreviewed")
+    problems = lint.lint_cache_group(registry=reg)
+    text = "\n".join(problems)
+    assert "juicefs_cache_group_peer_hits" in text  # missing expected
+    assert "rogue" in text                           # stray under prefix
+
+
 def test_lint_catches_bad_registrations():
     from juicefs_tpu.metric import Registry
 

@@ -181,6 +181,13 @@ def test_singleflight_dedups_scan_and_reader(pool):
                 store._rpool, window=2,
             ))
 
+        # the leader is parked on the gate, so the flight stays open until
+        # we release it — wait for BOTH the leader's GET and the follower's
+        # singleflight join (a fixed sleep flakes under full-suite load)
+        from juicefs_tpu.metric import global_registry
+
+        shared = global_registry()._metrics["juicefs_singleflight_shared"]
+        s0 = shared.value  # one follower join is the target delta
         t_scan = threading.Thread(target=scan)
         t_scan.start()
         reader_out = []
@@ -188,10 +195,10 @@ def test_singleflight_dedups_scan_and_reader(pool):
             target=lambda: reader_out.append(store._load_block(key, 1 << 16))
         )
         t_read.start()
-        deadline = time.time() + 2
-        while storage.get_calls == 0 and time.time() < deadline:
+        deadline = time.time() + 5
+        while (storage.get_calls == 0 or shared.value < s0 + 1) \
+                and time.time() < deadline:
             time.sleep(0.005)
-        time.sleep(0.05)  # give the second fetch time to join the leader
         storage.release.set()
         t_scan.join(timeout=5)
         t_read.join(timeout=5)

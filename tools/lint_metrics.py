@@ -25,6 +25,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def _populate_registry() -> None:
     """Import the modules whose metrics register at import time, and the
     runtime registrations that are cheap to trigger."""
+    import juicefs_tpu.cache.group          # noqa: F401  peer hit/miss/ring
+    import juicefs_tpu.cache.server         # noqa: F401  peer served counters
     import juicefs_tpu.chunk.cached_store   # noqa: F401  staging gauges
     import juicefs_tpu.chunk.disk_cache     # noqa: F401  disk tier counters
     import juicefs_tpu.chunk.mem_cache      # noqa: F401  cache hit/miss/evict
@@ -59,6 +61,46 @@ def lint(registry=None) -> list[str]:
         if m.kind not in ("counter", "gauge", "histogram"):
             problems.append(f"{m.name}: unknown metric kind {m.kind!r}")
     problems.extend(reg.conflicts)
+    return problems
+
+
+# the cache-group registry contract (ISSUE 4): the subsystem's metrics all
+# live under ONE prefix, and these series are load-bearing (tests and the
+# BENCHMARKS table counter-assert them) — a rename must fail CI, not
+# silently zero a dashboard
+CACHE_GROUP_PREFIX = "juicefs_cache_group_"
+CACHE_GROUP_EXPECTED = {
+    "juicefs_cache_group_peer_hits",
+    "juicefs_cache_group_peer_misses",
+    "juicefs_cache_group_peer_errors",
+    "juicefs_cache_group_ring_size",
+    "juicefs_cache_group_peer_get_seconds",
+    "juicefs_cache_group_served",
+    "juicefs_cache_group_served_bytes",
+    "juicefs_cache_group_serve_misses",
+}
+
+
+def lint_cache_group(registry=None) -> list[str]:
+    """Pin the juicefs_cache_group_* registry: every expected series
+    exists, and no stray metric squats under the prefix unreviewed."""
+    from juicefs_tpu.metric import global_registry
+
+    if registry is None:
+        _populate_registry()
+    reg = registry or global_registry()
+    names = {m.name for m in reg.walk()}
+    problems = [
+        f"{name}: cache-group metric missing from the registry"
+        for name in sorted(CACHE_GROUP_EXPECTED - names)
+    ]
+    problems += [
+        f"{name}: unreviewed metric under {CACHE_GROUP_PREFIX} (add it to "
+        "CACHE_GROUP_EXPECTED in tools/lint_metrics.py)"
+        for name in sorted(n for n in names
+                           if n.startswith(CACHE_GROUP_PREFIX)
+                           and n not in CACHE_GROUP_EXPECTED)
+    ]
     return problems
 
 
@@ -110,7 +152,7 @@ def lint_resilience(root: str | None = None) -> list[str]:
 
 
 def main() -> int:
-    problems = lint() + lint_resilience()
+    problems = lint() + lint_cache_group() + lint_resilience()
     if problems:
         for p in problems:
             print(f"lint_metrics: {p}", file=sys.stderr)

@@ -52,6 +52,9 @@ TEST_MAP = {
     "juicefs_tpu/chunk/disk_cache": ["tests/test_chunk.py"],
     "juicefs_tpu/object/resilient": ["tests/test_resilient.py",
                                      "tests/test_chaos.py"],
+    "juicefs_tpu/cache/ring": ["tests/test_cache_group.py"],
+    "juicefs_tpu/cache/group": ["tests/test_cache_group.py"],
+    "juicefs_tpu/cache/server": ["tests/test_cache_group.py"],
     "juicefs_tpu/object/fault": ["tests/test_resilient.py",
                                  "tests/test_chaos.py"],
     "juicefs_tpu/tpu/jth256": ["tests/test_tpu_hash.py"],
