@@ -357,6 +357,15 @@ def _device_bench(args, jax, step, rng, b, m, batch_bytes) -> int:
             line["e2e"] = run_e2e(2.0, ["cpu"])
         except Exception as exc:  # the headline must survive an e2e hiccup
             line["e2e"] = {"error": repr(exc)}
+    if not os.environ.get("JFS_BENCH_NO_INGEST"):
+        # write-path counterpart (ISSUE 5): ingest throughput with and
+        # without inline-dedup PUT elision, dup-ratio sweep — the perf
+        # trajectory's first write-side metric. Full tables + knobs:
+        # docs/BENCHMARKS.md §7.
+        try:
+            line["ingest"] = run_ingest_bench(0.5)
+        except Exception as exc:
+            line["ingest"] = {"error": repr(exc)}
     print(json.dumps(line))
     return 0
 
@@ -493,6 +502,201 @@ def run_e2e(gib: float, backends: list[str], block_mib: int = 4,
             shutil.rmtree(base, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Write/ingest benchmark (ISSUE 5): WSlice -> ingest dedup -> object PUTs on
+# a real file:// volume. Sweeps dup_ratio with elision off/on; reports
+# GiB/s, the pack/hash/lookup/compress/put stage breakdown, elided-PUT
+# counts with duplicate-block backend PUTs counter-asserted at ZERO, and a
+# byte-identical cold read-back checksum of the deduped data.
+# ---------------------------------------------------------------------------
+
+def run_ingest_bench(gib: float = 0.75, dup_ratios=(0.0, 0.3, 0.7),
+                     block_mib: int = 4, compress: str = "lz4",
+                     batch_blocks: int = 16, blocks_per_slice: int = 16) -> dict:
+    import shutil
+    import tempfile
+    import zlib
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from juicefs_tpu.chunk import (
+        CachedStore,
+        ChunkConfig,
+        ContentRefs,
+        IngestPipeline,
+    )
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.metric.trace import stage_metrics_snapshot
+    from juicefs_tpu.object import create_storage
+
+    bs = block_mib << 20
+    n_blocks = max(blocks_per_slice, int(gib * (1 << 30)) // bs)
+    out: dict = {"volume_gib": round(n_blocks * bs / (1 << 30), 3),
+                 "block_mib": block_mib, "compress": compress,
+                 "blocks": n_blocks, "batch_blocks": batch_blocks,
+                 "blocks_per_slice": blocks_per_slice, "sweep": {}}
+
+    _STAGES = ("chunk.ingest.hash", "chunk.ingest.lookup",
+               "chunk.ingest.register", "chunk.upload.pack",
+               "chunk.upload.compress", "chunk.upload.put")
+
+    class _CountingStore:
+        """Records every backend PUT key so duplicate-block PUTs can be
+        counter-asserted at zero (the elision acceptance gate)."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.put_keys: list[str] = []
+
+        def put(self, key, data):
+            self.put_keys.append(key)
+            return self._inner.put(key, data)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    def build(dup_ratio: float, elide: bool) -> dict:
+        base = tempfile.mkdtemp(prefix="jfs-ingest-")
+        slice_map: list = []
+        try:
+            m = new_client(f"sqlite3://{base}/meta.db")
+            m.init(Format(name="ingest", trash_days=0, block_size=bs >> 10,
+                          compression=compress, hash_backend="cpu"),
+                   force=True)
+            m.load()
+            storage = create_storage(f"file://{base}/blob")
+            storage.create()
+            counting = _CountingStore(storage)
+            store = CachedStore(counting, ChunkConfig(
+                block_size=bs, compress=compress, cache_size=1, max_upload=4))
+            if elide:
+                refs = ContentRefs(m)
+                store.content_refs = refs
+                store.ingest = IngestPipeline(
+                    store, refs, backend="cpu", batch_blocks=batch_blocks,
+                    flush_timeout=0.005)
+
+            # deterministic content plan: ~dup_ratio of blocks repeat one
+            # of 4 contents; dup_keys = every block whose content appeared
+            # before it (those are the PUTs elision must skip)
+            rng = np.random.default_rng(11)
+            dup_pool = [
+                rng.integers(0, 256, size=bs, dtype=np.uint8).tobytes()
+                for _ in range(4)
+            ]
+            blocks, seen, dup_idx = [], set(), []
+            for i in range(n_blocks):
+                if rng.random() < dup_ratio:
+                    data = dup_pool[int(rng.integers(0, len(dup_pool)))]
+                else:
+                    data = rng.integers(0, 256, size=bs,
+                                        dtype=np.uint8).tobytes()
+                key = hash(data)
+                if key in seen:
+                    dup_idx.append(i)
+                seen.add(key)
+                blocks.append(data)
+
+            before = stage_metrics_snapshot()
+            t0 = time.perf_counter()
+            for s0 in range(0, n_blocks, blocks_per_slice):
+                sid = m.new_slice()
+                chunk = blocks[s0:s0 + blocks_per_slice]
+                w = store.new_writer(sid)
+                for j, b in enumerate(chunk):
+                    w.write_at(b, j * bs)
+                w.finish(len(chunk) * bs)
+                slice_map.append((sid, len(chunk)))
+            if store.ingest is not None:
+                store.ingest.flush()
+            dt = time.perf_counter() - t0
+            after = stage_metrics_snapshot()
+
+            dup_keys = set()
+            pos = 0
+            for sid, cnt in slice_map:
+                for j in range(cnt):
+                    if pos in dup_idx:
+                        from juicefs_tpu.chunk import block_key
+
+                        dup_keys.add(block_key(sid, j, bs))
+                    pos += 1
+            dup_puts = sum(1 for k in counting.put_keys if k in dup_keys)
+            res = {
+                "gibs": round(n_blocks * bs / (1 << 30) / dt, 3),
+                "seconds": round(dt, 2),
+                "backend_puts": len(counting.put_keys),
+                "duplicate_blocks_written": len(dup_idx),
+                "duplicate_block_puts": dup_puts,  # MUST be 0 with elision
+                "stage_seconds": {
+                    k.rsplit(".", 1)[-1]: round(
+                        after.get(k, {}).get("sum_seconds", 0.0)
+                        - before.get(k, {}).get("sum_seconds", 0.0), 3)
+                    for k in _STAGES
+                },
+            }
+            if store.ingest is not None:
+                st = store.ingest.stats()
+                res["put_elided"] = st["put_elided"]
+                res["put_elided_bytes"] = st["put_elided_bytes"]
+                res["elided_pct"] = round(
+                    100.0 * st["put_elided"] / n_blocks, 1)
+                res["passthrough"] = st["passthrough"]
+                res["elision_correct"] = (
+                    dup_puts == 0 and st["put_elided"] == len(dup_idx))
+
+                # cold read-back of the deduped volume: byte-identical?
+                store.close()
+                cold = CachedStore(counting, ChunkConfig(
+                    block_size=bs, compress=compress, cache_size=1))
+                cold.content_refs = ContentRefs(m)
+                crc_src = crc_got = 0
+                identical = True
+                pos = 0
+                for sid, cnt in slice_map:
+                    r = cold.new_reader(sid, cnt * bs)
+                    for j in range(cnt):
+                        got = bytes(r.read(j * bs, bs))
+                        crc_got = zlib.crc32(got, crc_got)
+                        crc_src = zlib.crc32(blocks[pos], crc_src)
+                        if got != blocks[pos]:
+                            identical = False
+                        pos += 1
+                res["readback_crc32"] = crc_got
+                res["readback_identical"] = identical and crc_got == crc_src
+                cold.close()
+            else:
+                store.close()
+            return res
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+
+    for ratio in dup_ratios:
+        off = build(ratio, elide=False)
+        on = build(ratio, elide=True)
+        out["sweep"][str(ratio)] = {"off": off, "on": on,
+                                    "speedup": round(on["gibs"] / off["gibs"], 3)
+                                    if off["gibs"] else 0.0}
+    return out
+
+
+def main_ingest(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ingest", action="store_true")
+    ap.add_argument("--ingest-gib", type=float, default=0.75)
+    ap.add_argument("--ingest-compress", default="lz4")
+    args, _ = ap.parse_known_args(argv)
+    res = run_ingest_bench(args.ingest_gib, compress=args.ingest_compress)
+    at3 = res["sweep"].get("0.3", {})
+    print(json.dumps({
+        "metric": "ingest_throughput",
+        "value": at3.get("on", {}).get("gibs", 0.0),
+        "unit": "GiB/s (dup 0.3, inline-dedup on)",
+        "vs_off": at3.get("speedup", 0.0),
+        "ingest": res,
+    }))
+    return 0
+
+
 def main_e2e(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--e2e", action="store_true")
@@ -521,4 +725,6 @@ def main_e2e(argv=None) -> int:
 if __name__ == "__main__":
     if "--e2e" in sys.argv:
         sys.exit(main_e2e())
+    if "--ingest" in sys.argv:
+        sys.exit(main_ingest())
     sys.exit(main())

@@ -107,8 +107,8 @@ class PeerBlockServer:
             return None  # only well-formed block keys; no path games
         data = self.store.cache.load(key, count_miss=False)
         if data is None:
-            with self.store._pending_lock:
-                data = self.store._pending_staged.get(key)
+            # spilled staged entries (past the RAM cap) re-read their file
+            data = self.store._staged_lookup(key)
         return data
 
     def ring_view(self) -> dict:

@@ -6,4 +6,5 @@ staging, singleflight load dedup, and prefetching.
 """
 
 from .cached_store import CachedStore, ChunkConfig, block_key, parse_block_key  # noqa: F401
+from .ingest import ContentRefs, IngestPipeline  # noqa: F401
 from .singleflight import SingleFlight  # noqa: F401

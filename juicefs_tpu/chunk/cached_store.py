@@ -49,6 +49,8 @@ _H_READ = stage_hist("chunk", "read", "total")
 _H_FETCH = stage_hist("chunk", "load", "fetch")
 _H_UPLOAD = stage_hist("chunk", "upload", "put")
 _H_STAGE = stage_hist("chunk", "upload", "stage")
+_H_PACK = stage_hist("chunk", "upload", "pack")
+_H_COMPRESS = stage_hist("chunk", "upload", "compress")
 
 # staging backlog gauges (reference juicefs_staging_blocks/bytes) aggregate
 # over every live store — weak refs so a gauge closure never pins a
@@ -66,14 +68,49 @@ def _sum_staging(fn) -> float:
     return total
 
 
+class _SpilledStaged:
+    """A staged block whose raw bytes were evicted from RAM past the
+    `staged_mem_bytes` cap: only the staging-file path is pinned; replay
+    and staged reads re-read the file (ISSUE 5 satellite — a long
+    brownout must not grow `_pending_staged` without bound)."""
+
+    __slots__ = ("path", "size")
+
+    def __init__(self, path: str, size: int):
+        self.path = path
+        self.size = size
+
+
+def _staged_len(v) -> int:
+    return v.size if isinstance(v, _SpilledStaged) else len(v)
+
+
 global_registry().gauge(
     "juicefs_staging_blocks", "Blocks staged for writeback upload"
 ).set_function(lambda: _sum_staging(lambda s: len(s._pending_staged)))
 global_registry().gauge(
     "juicefs_staging_bytes", "Bytes staged for writeback upload"
 ).set_function(lambda: _sum_staging(
-    lambda s: sum(len(v) for v in list(s._pending_staged.values()))
+    lambda s: sum(_staged_len(v) for v in list(s._pending_staged.values()))
 ))
+global_registry().gauge(
+    "juicefs_staging_mem_bytes",
+    "Staged raw bytes currently pinned in RAM (the rest spilled to "
+    "staging files)",
+).set_function(lambda: _sum_staging(lambda s: s._staged_mem))
+
+# shared zero source for block padding: extending a bytearray still copies,
+# but the pad SOURCE is allocated once instead of a fresh ~4 MiB zeros
+# object per short block
+_ZERO_CHUNK = bytes(1 << 20)
+
+
+def _zero_pad(buf: bytearray, n: int) -> None:
+    mv = memoryview(_ZERO_CHUNK)
+    while n > 0:
+        step = min(n, len(_ZERO_CHUNK))
+        buf += mv[:step]
+        n -= step
 
 
 def block_key(sid: int, indx: int, bsize: int) -> str:
@@ -117,6 +154,9 @@ class ChunkConfig:
     # hook for the TPU fingerprint plane: called with (key, raw_block)
     # on every upload (SURVEY.md §7.4); None disables
     fingerprint: Optional[Callable[[str, bytes], None]] = None
+    # cap on staged raw bytes pinned in RAM; entries past it spill to
+    # their staging files and are re-read at replay (ISSUE 5 satellite)
+    staged_mem_bytes: int = 128 << 20
 
 
 class TornDataError(IOError):
@@ -161,10 +201,19 @@ class CachedStore:
         self._group = SingleFlight()
         self._fetcher = Prefetcher(self._prefetch_block, workers=self.conf.prefetch)
         self._pending_lock = threading.Lock()
-        self._pending_staged: dict[str, bytes] = {}  # writeback: key -> raw data
+        # writeback backlog: key -> raw bytes, or _SpilledStaged past the
+        # staged_mem_bytes RAM cap (re-read from the staging file)
+        self._pending_staged: dict[str, object] = {}
+        self._staged_mem = 0  # raw bytes currently pinned in RAM
         # content indexer (chunk/indexer.py), attached by cmd.build_store
         # when the volume has a hash_backend
         self.indexer = None
+        # content-ref plane (chunk/ingest.py ContentRefs), attached by
+        # build_store whenever a meta engine is available: resolves read
+        # misses through aliases and decrefs deletes. `ingest` is the
+        # inline-dedup stage itself (--inline-dedup mounts only).
+        self.content_refs = None
+        self.ingest = None
         # cache group (cache/group.py), attached by cmd/mount or tests:
         # the peer rung between the local cache and the object store
         self.cache_group = None
@@ -198,17 +247,24 @@ class CachedStore:
         running on the degradation ladder)."""
         return bool(getattr(self.storage, "degraded", False))
 
-    def _put_block(self, key: str, raw: bytes, parent=None) -> None:
+    def _put_block(self, key: str, raw: bytes, parent=None,
+                   fingerprint: bool = True) -> None:
         """Compress (+fingerprint) and PUT one block
         (reference cached_store.go:371-413 upload). `parent` is the span
-        ref captured before the upload-pool crossing."""
+        ref captured before the upload-pool crossing. The ingest stage
+        passes fingerprint=False — it already hashed the block and wrote
+        the index row itself."""
         with _TR.span("chunk", "upload", stage="put", hist=_H_UPLOAD,
                       parent=parent) as sp:
             if sp.active:
                 sp.set(key=key, bytes=len(raw))
-            if self.conf.fingerprint is not None:
+            if fingerprint and self.conf.fingerprint is not None:
                 self.conf.fingerprint(key, raw)
-            data = self.compressor.compress(raw)
+            with _TR.span("chunk", "upload", stage="compress",
+                          hist=_H_COMPRESS) as csp:
+                if csp.active:
+                    csp.set(key=key, bytes=len(raw))
+                data = self.compressor.compress(raw)
             self.storage.put(key, data)
 
     def _note_cache_hit(self, key: str, bsize: int) -> None:
@@ -232,8 +288,7 @@ class CachedStore:
             if cached is not None:
                 self._note_cache_hit(key, bsize)
                 return cached
-            with self._pending_lock:
-                staged = self._pending_staged.get(key)
+            staged = self._staged_lookup(key)
             if staged is not None:
                 return staged
 
@@ -251,7 +306,17 @@ class CachedStore:
                     return peer_data
 
             def fetch() -> bytes:
-                data = self.storage.get(key)
+                try:
+                    data = self.storage.get(key)
+                except NotFoundError:
+                    # inline dedup (ISSUE 5): an elided block has no object
+                    # of its own — resolve the alias and fetch the
+                    # canonical. Untracked blocks re-raise (a real miss);
+                    # the non-dedup hot path pays nothing here.
+                    canonical = self._resolve_alias(key)
+                    if canonical is None:
+                        raise
+                    data = self.storage.get(canonical)
                 raw = self.compressor.decompress(data, bsize)
                 if len(raw) != bsize:
                     # short/over-long response (flaky backend, truncated
@@ -273,6 +338,18 @@ class CachedStore:
             return raw
 
         return self._group.do(key, do)
+
+    def _resolve_alias(self, key: str) -> Optional[str]:
+        """Canonical block key for an elided (aliased) block, or None when
+        the block is untracked by the content-ref plane."""
+        refs = self.content_refs
+        if refs is None:
+            return None
+        try:
+            return refs.resolve(key)
+        except Exception as e:  # meta hiccup: surface the original miss
+            logger.warning("alias resolve %s: %s", key, e)
+            return None
 
     def _prefetch_block(self, key_size) -> bool:
         """Returns True only when this call actually warmed the block
@@ -323,23 +400,51 @@ class CachedStore:
         download pool.  A NotFoundError is idempotent success (the block
         was already gone — retries, crashed removals, racing gc), so only
         real backend failures are logged and counted.  Returns the number
-        of real failures."""
+        of real failures.
+
+        With a content-ref plane attached (inline dedup, ISSUE 5), every
+        block is decref'd in one meta transaction first: a block whose
+        content other blocks still reference keeps its canonical object
+        alive ("released" — zero backend calls); the FINAL reference
+        deletes the canonical, which may be a different key when an alias
+        outlived its canonical's own slice."""
+        keys = [key for key, _ in self._block_range(sid, length)]
+        # per-key physical delete target: own key (untracked/dangling),
+        # the canonical key (last ref), or None (refs remain)
+        targets: dict[str, Optional[str]] = {k: k for k in keys}
+        refs = self.content_refs
+        if refs is not None:
+            try:
+                released = refs.release(keys)
+            except Exception as e:
+                # meta down: fall back to name-based deletes — aliased
+                # blocks' objects don't exist (idempotent NotFound) and a
+                # canonical deleted early is caught by gc reconciliation
+                logger.warning("content decref slice %d: %s", sid, e)
+                released = [("untracked", None)] * len(keys)
+            for key, (disp, canonical) in zip(keys, released):
+                if disp == "released":
+                    targets[key] = None
+                elif disp == "last":
+                    targets[key] = canonical
+
         def drop(key: str) -> int:
             self.cache.remove(key)
-            with self._pending_lock:
-                self._pending_staged.pop(key, None)
+            self._unpark_staged(key)
+            target = targets.get(key, key)
+            if target is None:
+                return 0  # content still referenced: PUT-elided delete
             try:
-                self.storage.delete(key)
+                self.storage.delete(target)
             except NotFoundError:
                 pass
             except Exception as e:
-                logger.warning("remove %s: %s", key, e)
+                logger.warning("remove %s: %s", target, e)
                 return 1
             return 0
 
         return sum(failed for _, failed in fetch_ordered(
-            [key for key, _ in self._block_range(sid, length)],
-            drop, self._rpool, self.conf.max_download,
+            keys, drop, self._rpool, self.conf.max_download,
         ))
 
     def fill_cache(self, sid: int, length: int, only=None) -> None:
@@ -376,6 +481,10 @@ class CachedStore:
     def flush_all(self, timeout: float = 60.0) -> None:
         """Drain pending writeback uploads (used by fsync paths and tests)."""
         deadline = time.time() + timeout
+        if self.ingest is not None:
+            # the ingest stage feeds the upload pool; drain it first so
+            # its uploads land in _pending_staged accounting below
+            self.ingest.flush(timeout)
         while time.time() < deadline:
             with self._pending_lock:
                 drained = not self._pending_staged
@@ -398,6 +507,11 @@ class CachedStore:
 
     def close(self) -> None:
         """Orderly shutdown: drain uploads, stop workers, free dir locks."""
+        if self.ingest is not None:
+            try:
+                self.ingest.close()  # stops feeding the pool before shutdown
+            except Exception:
+                pass
         self._pool.shutdown(wait=True)
         self._fetcher.close()  # stop issuing new loads before teardown
         self._rpool.shutdown(wait=True, cancel_futures=True)
@@ -416,6 +530,66 @@ class CachedStore:
         except Exception:
             pass
         self.release_cache_locks()
+
+    # -- staged-block bookkeeping (bounded RAM, ISSUE 5 satellite) ---------
+    def _park_staged(self, key: str, raw: bytes, path: Optional[str]):
+        """Track a staged block for replay. Raw bytes stay pinned in RAM
+        up to `staged_mem_bytes`; past the cap (a long brownout piling up
+        degraded writes) entries with a staging file keep only the path
+        and are re-read at replay. Returns the parked value."""
+        with self._pending_lock:
+            if (path is not None
+                    and self._staged_mem + len(raw) > self.conf.staged_mem_bytes):
+                parked: object = _SpilledStaged(path, len(raw))
+            else:
+                parked = raw
+                self._staged_mem += len(raw)
+            prev = self._pending_staged.get(key)
+            if prev is not None and not isinstance(prev, _SpilledStaged):
+                self._staged_mem -= len(prev)  # overwrite: same key re-staged
+            self._pending_staged[key] = parked
+        return parked
+
+    def _unpark_staged(self, key: str) -> None:
+        with self._pending_lock:
+            prev = self._pending_staged.pop(key, None)
+            if prev is not None and not isinstance(prev, _SpilledStaged):
+                self._staged_mem -= len(prev)
+
+    def _staged_lookup(self, key: str) -> Optional[bytes]:
+        """Raw bytes of a staged block (reads during writeback/outage);
+        spilled entries re-read their staging file."""
+        with self._pending_lock:
+            v = self._pending_staged.get(key)
+        return self._materialize_staged(key, v)
+
+    def _materialize_staged(self, key: str, v) -> Optional[bytes]:
+        import errno as _errno
+
+        if v is None or not isinstance(v, _SpilledStaged):
+            return v
+        try:
+            with open(v.path, "rb") as f:
+                raw = f.read(v.size)  # uploaded() may trailer the file later
+        except OSError as e:
+            if e.errno == _errno.ENOENT:
+                # staging file truly gone (cache dir cleaned): the entry
+                # is unrecoverable — drop it so replay/flush don't spin
+                logger.warning("spilled staged block %s lost (%s)",
+                               key, v.path)
+                self._unpark_staged(key)
+            else:
+                # transient read failure (EMFILE/EINTR/EIO): the file is
+                # still there — KEEP the entry for a later replay; the
+                # data was acked and must never be silently dropped
+                logger.warning("spilled staged block %s unreadable (%s); "
+                               "keeping for replay", key, e)
+            return None
+        if len(raw) != v.size:
+            logger.warning("spilled staged block %s truncated", key)
+            self._unpark_staged(key)
+            return None
+        return raw
 
     # -- writeback recovery ------------------------------------------------
     def _recover_staging(self) -> None:
@@ -437,11 +611,13 @@ class CachedStore:
                 # the file) never enshrines the stale bytes in the cache
                 self.cache.stage(key, raw)
             logger.warning("found staged block %s, uploading", key)
-            with self._pending_lock:
-                self._pending_staged[key] = raw
-            self._pool.submit(self._upload_staged, key, raw)
+            parked = self._park_staged(key, raw, path)
+            self._pool.submit(self._upload_staged, key, parked)
 
-    def _upload_staged(self, key: str, raw: bytes, parent=None) -> None:
+    def _upload_staged(self, key: str, staged, parent=None) -> None:
+        raw = self._materialize_staged(key, staged)
+        if raw is None:
+            return  # spilled entry lost its file; already dropped
         try:
             self._put_block(key, raw, parent)
             self.cache.uploaded(key, len(raw))
@@ -452,11 +628,16 @@ class CachedStore:
             logger.warning("upload %s deferred: breaker open", key)
             return
         except Exception:
-            with self._pending_lock:
-                self._pending_staged.pop(key, None)
+            self._unpark_staged(key)
             raise
-        with self._pending_lock:
-            self._pending_staged.pop(key, None)
+        self._unpark_staged(key)
+
+    def _stage_degraded(self, key: str, raw: bytes) -> None:
+        """Ladder rung 2: park an already-acked block in staging for the
+        breaker-reset replay instead of failing it back to the caller."""
+        path = self.cache.stage(key, raw)
+        self._park_staged(key, raw, path)
+        logger.warning("degraded write: %s staged for replay", key)
 
     def _put_or_stage(self, key: str, raw: bytes, parent=None) -> None:
         """Async upload worker for the non-writeback path: a breaker that
@@ -465,10 +646,7 @@ class CachedStore:
         try:
             self._put_block(key, raw, parent)
         except BreakerOpenError:
-            self.cache.stage(key, raw)
-            with self._pending_lock:
-                self._pending_staged[key] = raw
-            logger.warning("degraded write: %s staged for replay", key)
+            self._stage_degraded(key, raw)
 
     def _replay_staged(self) -> None:
         """Breaker-reset hook: re-upload every block degraded writes (or
@@ -479,9 +657,9 @@ class CachedStore:
         if not items:
             return
         logger.warning("breaker reset: replaying %d staged blocks", len(items))
-        for key, raw in items:
+        for key, staged in items:
             try:
-                self._pool.submit(self._upload_staged, key, raw)
+                self._pool.submit(self._upload_staged, key, staged)
             except RuntimeError:
                 return  # pool already shut down: restart recovery owns it
 
@@ -539,7 +717,13 @@ class WSlice:
         # cost real bandwidth, and nothing mutates it after the pop
         raw = self._blocks.pop(indx)
         if len(raw) < bsize:
-            raw += b"\x00" * (bsize - len(raw))
+            # pad from the shared zero source (no fresh multi-MiB zeros
+            # object per short block); the pack span makes the cost of
+            # short-block padding visible next to compress/put
+            with _TR.span("chunk", "upload", stage="pack", hist=_H_PACK) as sp:
+                if sp.active:
+                    sp.set(sid=self.id, indx=indx, pad=bsize - len(raw))
+                _zero_pad(raw, bsize - len(raw))
         self._uploaded.add(indx)
         key = block_key(self.id, indx, bsize)
         ref = _TR.current_ref()  # link pool-side upload spans to this write
@@ -555,18 +739,24 @@ class WSlice:
                 if sp.active:
                     sp.set(key=key, bytes=len(raw))
                 path = self.store.cache.stage(key, raw)
-            with self.store._pending_lock:
-                self.store._pending_staged[key] = raw
+            parked = self.store._park_staged(key, raw, path)
             if degraded:
                 logger.warning("degraded write: %s staged for replay", key)
             elif path is not None:
-                self.store._pool.submit(self.store._upload_staged, key, raw, ref)
+                self.store._pool.submit(self.store._upload_staged, key, parked, ref)
             else:  # staging failed: fall back to sync-ish upload
                 self._futures.append(
-                    self.store._pool.submit(self.store._upload_staged, key, raw, ref)
+                    self.store._pool.submit(self.store._upload_staged, key, parked, ref)
                 )
         else:
-            fut = self.store._pool.submit(self.store._put_or_stage, key, raw, ref)
+            # inline-dedup seam (ISSUE 5): with an ingest stage attached,
+            # the block flows hash -> content-ref lookup -> elide-or-PUT;
+            # without one it goes straight to the upload pool as before
+            ingest = self.store.ingest
+            if ingest is not None:
+                fut = ingest.submit(key, raw, ref)
+            else:
+                fut = self.store._pool.submit(self.store._put_or_stage, key, raw, ref)
             fut.add_done_callback(
                 lambda f, k=key, r=raw: self.store.cache.cache(k, r) if not f.exception() else None
             )
@@ -584,6 +774,10 @@ class WSlice:
                 if indx not in self._blocks:
                     self._blocks[indx] = bytearray()  # hole: zero-filled block
                 self._upload_block(indx, last_size if indx == n_blocks - 1 else self.bs)
+        if self.store.ingest is not None:
+            # commit barrier: hash whatever the ingest stage buffered NOW
+            # instead of waiting out its flush timeout
+            self.store.ingest.kick()
         errs = []
         for fut in self._futures:
             e = fut.exception()
@@ -700,8 +894,7 @@ class RSlice:
                 small = n < bsize // 4 and self.store.compressor.name == ""
                 if small:
                     # partial GET without caching (reference: range read path)
-                    with self.store._pending_lock:
-                        staged = self.store._pending_staged.get(key)
+                    staged = self.store._staged_lookup(key)
                     if staged is not None:
                         out += staged[boff : boff + n]
                     else:
@@ -709,7 +902,14 @@ class RSlice:
                         # speculative probe above suppressed lands here
                         self.store._count_miss()
                         def ranged(k=key, o=boff, ln=n) -> bytes:
-                            data = self.store.storage.get(k, o, ln)
+                            try:
+                                data = self.store.storage.get(k, o, ln)
+                            except NotFoundError:
+                                # elided block: ranged-read its canonical
+                                canonical = self.store._resolve_alias(k)
+                                if canonical is None:
+                                    raise
+                                data = self.store.storage.get(canonical, o, ln)
                             if len(data) != ln:
                                 # short read: retry, never return torn data
                                 raise TornDataError(

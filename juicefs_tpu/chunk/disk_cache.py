@@ -129,11 +129,15 @@ class DiskCache:
         with self._lock:
             if key in self._index:
                 return
-            self._index[key] = (ondisk, time.time())
-            self._used += ondisk
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = path + ".tmp"
+            # per-thread tmp name, and the index entry is published only
+            # AFTER the atomic replace: a concurrent cache() of the same
+            # key (writer done-callback vs read populate) must neither
+            # corrupt a shared tmp file nor make load() miss while the
+            # first writer is still mid-write — block contents for one
+            # key are immutable, so last-replace-wins is safe
+            tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
             with open(tmp, "wb") as f:
                 f.write(data)
                 if self.checksum:
@@ -144,10 +148,12 @@ class DiskCache:
             os.replace(tmp, path)
         except OSError as e:
             logger.warning("cache write failed %s: %s", key, e)
-            with self._lock:
-                if self._index.pop(key, None) is not None:
-                    self._used -= ondisk
             return
+        with self._lock:
+            if key in self._index:
+                return  # racing writer published the same content first
+            self._index[key] = (ondisk, time.time())
+            self._used += ondisk
         self._maybe_evict()
 
     def load(self, key: str, count_miss: bool = True) -> Optional[bytes]:

@@ -1,0 +1,507 @@
+"""Inline ingest dedup: TPU-hashed PUT elision on the write path (ISSUE 5).
+
+The stage between `WSlice._upload_block` and the upload pool. Outgoing
+blocks are batched through the JTH-256 hash plane (tpu/pipeline.py
+HashBatcher: device-sized batches with a flush timeout so a lone block's
+commit barrier never waits out a batch window), then the digest is looked
+up in the meta engine's content-ref plane:
+
+  hit  -> the store already holds these bytes under a canonical block.
+          One transaction increfs the ref row and records an alias for
+          this block; compress + PUT are SKIPPED entirely (zero backend
+          calls for the duplicate — Venti's content-addressed write
+          elision, Quinlan & Dorward FAST '02, grafted onto slice-id
+          block naming via the alias plane).
+  miss -> compress + PUT exactly as before, then register the digest so
+          later duplicates elide against this block. A register that
+          finds the row already present lost a cross-client race: it
+          increfs instead, the redundant object is deleted best-effort,
+          and the block becomes an alias of the winner.
+
+Overload contract (same as chunk/indexer.py, per Zhu et al. FAST '08:
+inline fingerprinting must never throttle ingest): `submit` NEVER blocks.
+A full hash queue, a hash failure, or a meta failure all degrade the
+block to the plain upload path (counted as passthrough/errors) — elision
+is an optimization, durability never waits for it.
+
+Crash windows (repaired offline by `gc --dedup`, cmd/gc.py):
+  - elide committed (incref txn) but the slice never commits to meta:
+    the alias row is orphaned; reconciliation decrefs it.
+  - PUT succeeded but register never ran: the content is simply not
+    elidable yet; gc's backfill registers existing blocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from concurrent.futures import Future
+from typing import Optional
+
+from ..metric import global_registry
+from ..metric.trace import global_tracer, stage_hist
+from ..object.resilient import BreakerOpenError
+from ..utils import get_logger
+from .cached_store import block_key, parse_block_key
+
+logger = get_logger("chunk.ingest")
+
+_TR = global_tracer()
+_H_HASH = stage_hist("chunk", "ingest", "hash")
+_H_LOOKUP = stage_hist("chunk", "ingest", "lookup")
+_H_REGISTER = stage_hist("chunk", "ingest", "register")
+
+_reg = global_registry()
+_BLOCKS = _reg.counter(
+    "juicefs_ingest_blocks", "Blocks entering the inline-dedup ingest stage"
+)
+_BYTES = _reg.counter(
+    "juicefs_ingest_bytes", "Raw bytes entering the ingest stage"
+)
+_ELIDED = _reg.counter(
+    "juicefs_ingest_put_elided",
+    "Duplicate blocks whose compress+PUT was skipped (alias recorded)",
+)
+_ELIDED_BYTES = _reg.counter(
+    "juicefs_ingest_put_elided_bytes", "Raw bytes of elided duplicate PUTs"
+)
+_UPLOADED = _reg.counter(
+    "juicefs_ingest_uploaded", "Blocks uploaded as new canonical content"
+)
+_PASSTHROUGH = _reg.counter(
+    "juicefs_ingest_passthrough",
+    "Blocks bypassing dedup (hash plane saturated or degraded) and "
+    "uploaded directly",
+)
+_RACE_COLLAPSED = _reg.counter(
+    "juicefs_ingest_race_collapsed",
+    "Concurrent-writer races collapsed: our upload found the digest "
+    "already registered and became an alias",
+)
+_ERRORS = _reg.counter(
+    "juicefs_ingest_errors",
+    "Hash/meta failures degraded to the plain upload path",
+)
+
+# queue-depth gauge aggregates over live pipelines via weak refs (same
+# pattern as chunk/indexer.py: closures must not pin discarded stages)
+_LIVE_PIPELINES: "weakref.WeakSet[IngestPipeline]" = weakref.WeakSet()
+
+
+def _queued_blocks() -> int:
+    total = 0
+    try:
+        for p in list(_LIVE_PIPELINES):
+            total += p._batcher.qsize()
+    except Exception:
+        pass
+    return total
+
+
+_reg.gauge(
+    "juicefs_ingest_queue_blocks", "Blocks queued for ingest hashing"
+).set_function(_queued_blocks)
+
+
+def alias_map(meta) -> dict[str, str]:
+    """Snapshot {alias block key -> canonical block key} for offline
+    consumers (gc leaked/missing diff, fsck existence checks): an elided
+    block has no object of its own, so name-based sweeps must translate
+    through the content-ref plane."""
+    refs = {
+        digest: block_key(*canonical)
+        for digest, canonical, _refs in meta.scan_content_refs()
+    }
+    out: dict[str, str] = {}
+    for (sid, indx), digest, bsize, _ts in meta.scan_content_aliases():
+        canonical = refs.get(digest)
+        key = block_key(sid, indx, bsize)
+        if canonical is not None and canonical != key:
+            out[key] = canonical
+    return out
+
+
+class ContentRefs:
+    """Adapter between block keys and the meta content-ref plane
+    (meta/base.py content_* contract). Used by the ingest stage (incref/
+    register), the read path (resolve on NotFound) and the delete path
+    (release), so the store never touches digest rows directly."""
+
+    def __init__(self, meta):
+        self.meta = meta
+
+    def incref(self, entries: list) -> list:
+        return self.meta.content_incref(entries)
+
+    def register(self, entries: list) -> list:
+        return self.meta.content_register(entries)
+
+    def resolve(self, key: str) -> Optional[str]:
+        """Canonical block key serving `key`'s bytes (None = untracked)."""
+        parsed = parse_block_key(key)
+        if parsed is None:
+            return None
+        canonical = self.meta.content_resolve(parsed[0], parsed[1])
+        if canonical is None:
+            return None
+        ck = block_key(*canonical)
+        return None if ck == key else ck
+
+    def release(self, keys: list[str]) -> list[tuple[str, Optional[str]]]:
+        """Decref every tracked key being deleted. Per key returns
+        (disposition, canonical_key): "untracked" -> delete the object as
+        usual; "released" -> refs remain, do NOT delete the canonical
+        object; "last" -> delete the canonical object (which may differ
+        from `key` when an alias outlives its canonical's own slice)."""
+        parsed = [parse_block_key(k) for k in keys]
+        pairs = [(p[0], p[1]) for p in parsed if p is not None]
+        if not pairs:
+            return [("untracked", None)] * len(keys)
+        results = iter(self.meta.content_decref(pairs))
+        out: list[tuple[str, Optional[str]]] = []
+        for p in parsed:
+            if p is None:
+                out.append(("untracked", None))
+                continue
+            disp, canonical = next(results)
+            out.append(
+                (disp, block_key(*canonical) if canonical is not None else None)
+            )
+        return out
+
+
+class IngestPipeline:
+    """Batched hash -> content-ref lookup -> elide-or-upload stage.
+
+    `submit(key, raw, parent)` is the WSlice seam: non-blocking, returns a
+    Future resolved when the block is durable (elided, uploaded, or staged
+    by the degradation ladder) — the WSlice commit barrier waits on it
+    exactly as it waits on plain upload-pool futures.
+    """
+
+    def __init__(
+        self,
+        store,
+        refs: ContentRefs,
+        backend: str = "cpu",
+        batch_blocks: int = 32,
+        queue_blocks: int = 64,
+        flush_timeout: float = 0.005,
+    ):
+        from ..tpu.pipeline import HashBatcher, HashPipeline, PipelineConfig
+
+        self.store = store
+        self.refs = refs
+        self.backend = backend
+        self._batcher = HashBatcher(
+            HashPipeline(
+                PipelineConfig(
+                    backend=backend,
+                    batch_blocks=batch_blocks,
+                    pad_lanes=max(1, store.conf.block_size // 65536),
+                )
+            ),
+            queue_blocks=queue_blocks,
+            flush_timeout=flush_timeout,
+        )
+        self._lock = threading.Lock()
+        self._outstanding: set[Future] = set()
+        self._closed = False
+        # miss groups flow worker -> upload pool (PUT) -> finalizer, which
+        # waits the PUTs and commits ONE register txn + ONE follower
+        # incref txn per hash batch (per-upload txns measured 10x the
+        # lookup cost on sqlite); hashing of batch k+1 overlaps both
+        import queue as _queue
+
+        self._finalq: "_queue.Queue" = _queue.Queue()
+        # stats mirror of the global counters, per pipeline (bench/tests)
+        self.blocks = 0
+        self.elided = 0
+        self.elided_bytes = 0
+        self.uploaded = 0
+        self.passthrough = 0
+        self.race_collapsed = 0
+        self.errors = 0
+        _LIVE_PIPELINES.add(self)
+        self._thread = threading.Thread(
+            target=self._loop, name="ingest-dedup", daemon=True
+        )
+        self._thread.start()
+        self._finalizer = threading.Thread(
+            target=self._finalize_loop, name="ingest-finalize", daemon=True
+        )
+        self._finalizer.start()
+
+    # -- producer side (WSlice upload seam) --------------------------------
+    def submit(self, key: str, raw, parent=None) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            closed = self._closed
+            self._outstanding.add(fut)
+        fut.add_done_callback(self._done)
+        parsed = parse_block_key(key)
+        if parsed is None:
+            return self._passthrough(key, raw, parent, fut, count=False)
+        _BLOCKS.inc()
+        _BYTES.inc(len(raw))
+        self.blocks += 1
+        if closed or not self._batcher.submit((key, raw, parent, fut, parsed)):
+            # hash plane saturated (or a racing close()): the write must
+            # not wait for dedup — and an item enqueued behind the CLOSE
+            # sentinel would never resolve its future
+            return self._passthrough(key, raw, parent, fut)
+        return fut
+
+    def kick(self) -> None:
+        """Commit barrier hint (WSlice.finish): flush the partial batch
+        now instead of waiting out the flush timeout."""
+        self._batcher.kick()
+
+    def _done(self, fut: Future) -> None:
+        with self._lock:
+            self._outstanding.discard(fut)
+
+    def _passthrough(self, key, raw, parent, fut: Future, count=True) -> Future:
+        """Plain upload (no dedup): chain the caller-visible future onto
+        an upload-pool task, preserving exception propagation. count=True
+        (every dedup-degrade path: overload, racing close, meta-failure
+        fallbacks) records the block as a passthrough; count=False is the
+        foreign-key path, which was never dedup-eligible."""
+        if count:
+            _PASSTHROUGH.inc()
+            self.passthrough += 1
+        try:
+            pool_fut = self.store._pool.submit(
+                self.store._put_or_stage, key, raw, parent
+            )
+        except RuntimeError as e:  # pool shut down mid-teardown
+            fut.set_exception(e)
+            return fut
+
+        def chain(pf, fut=fut):
+            e = pf.exception()
+            if e is not None:
+                fut.set_exception(e)
+            else:
+                fut.set_result(None)
+
+        pool_fut.add_done_callback(chain)
+        return fut
+
+    # -- worker ------------------------------------------------------------
+    def _loop(self) -> None:
+        for batch in self._batcher.batches():
+            try:
+                self._process(batch)
+            except Exception as e:
+                # dedup is advisory: a broken batch degrades, never fails
+                _ERRORS.inc(len(batch))
+                self.errors += len(batch)
+                logger.warning("ingest batch of %d degraded: %s", len(batch), e)
+                for key, raw, parent, fut, _p in batch:
+                    if not fut.done():
+                        self._passthrough(key, raw, parent, fut)
+
+    def _process(self, batch: list) -> None:
+        with _TR.span("chunk", "ingest", stage="hash", hist=_H_HASH) as sp:
+            if sp.active:
+                sp.set(blocks=len(batch), backend=self.backend)
+            digests = self._batcher.pipe.hash_blocks(
+                [raw for _, raw, _, _, _ in batch]
+            )
+        # keep the advisory content index complete for gc/fsck: elided
+        # blocks never reach the _put_block fingerprint hook, and we hold
+        # every digest right here — one batched meta txn
+        if getattr(self.refs.meta, "set_block_digests", None) is not None:
+            self.refs.meta.set_block_digests(
+                [
+                    (sid, indx, bsize, digests[i])
+                    for i, (_, _, _, _, (sid, indx, bsize)) in enumerate(batch)
+                ]
+            )
+
+        # one lookup txn for the whole batch; same-digest groups resolve
+        # together (all hit, or all miss with one leader upload)
+        with _TR.span("chunk", "ingest", stage="lookup", hist=_H_LOOKUP) as sp:
+            if sp.active:
+                sp.set(blocks=len(batch))
+            results = self.refs.incref(
+                [
+                    (digests[i], sid, indx, bsize)
+                    for i, (_, _, _, _, (sid, indx, bsize)) in enumerate(batch)
+                ]
+            )
+
+        groups: dict[bytes, list] = {}
+        for i, item in enumerate(batch):
+            key, raw, parent, fut, parsed = item
+            if results[i] is not None:
+                # duplicate: alias recorded, refcount bumped — NO backend
+                # call for this block, ever
+                _ELIDED.inc()
+                _ELIDED_BYTES.inc(len(raw))
+                self.elided += 1
+                self.elided_bytes += len(raw)
+                fut.set_result(None)
+            else:
+                groups.setdefault(digests[i], []).append(item)
+
+        jobs = []
+        for digest, members in groups.items():
+            leader = members[0]
+            try:
+                pf = self.store._pool.submit(
+                    self.store._put_block, leader[0], leader[1], leader[2],
+                    False,  # fingerprint=False: digest already recorded
+                )
+            except RuntimeError as e:
+                for m in members:
+                    m[3].set_exception(e)
+                continue
+            jobs.append((digest, members, pf))
+        if jobs:
+            self._finalq.put(jobs)
+
+    def _finalize_loop(self) -> None:
+        """Wait each batch's canonical PUTs, then commit ONE register txn
+        for the new content and ONE incref txn for same-batch followers —
+        amortizing meta commits over the batch while batch k+1 hashes."""
+        while True:
+            jobs = self._finalq.get()
+            if jobs is None:
+                return
+            try:
+                self._finalize(jobs)
+            except Exception as e:
+                logger.warning("ingest finalize degraded: %s", e)
+                for _digest, members, _pf in jobs:
+                    for m in members:
+                        if not m[3].done():
+                            m[3].set_exception(e)
+
+    def _finalize(self, jobs: list) -> None:
+        ok: list = []  # (digest, members) whose canonical PUT landed
+        for digest, members, pf in jobs:
+            try:
+                pf.result()
+            except BreakerOpenError:
+                # mid-flight outage: the whole group degrades to staging
+                # (ladder rung 2) and stays un-registered — replay uploads
+                # raw bytes per key, no aliasing during an outage
+                for m in members:
+                    self.store._stage_degraded(m[0], m[1])
+                    m[3].set_result(None)
+                continue
+            except Exception as e:
+                for m in members:
+                    m[3].set_exception(e)
+                continue
+            _UPLOADED.inc()
+            self.uploaded += 1
+            ok.append((digest, members))
+        if not ok:
+            return
+        try:
+            with _TR.span("chunk", "ingest", stage="register",
+                          hist=_H_REGISTER) as sp:
+                if sp.active:
+                    sp.set(groups=len(ok))
+                results = self.refs.register(
+                    [(digest, *members[0][4]) for digest, members in ok]
+                )
+        except Exception as e:
+            # meta hiccup AFTER the PUTs: blocks are durable, just not
+            # elidable yet (gc --dedup backfills registration); followers
+            # below fall back to their own uploads
+            _ERRORS.inc(len(ok))
+            self.errors += len(ok)
+            logger.warning("register batch failed: %s", e)
+            results = None
+        followers: list = []  # flattened (digest, member) across groups
+        for i, (digest, members) in enumerate(ok):
+            leader = members[0]
+            existing = results[i] if results is not None else None
+            if existing is not None and existing != leader[4]:
+                # cross-client race: someone registered this content first
+                # and our register collapsed to an incref — our object is
+                # redundant
+                _RACE_COLLAPSED.inc()
+                self.race_collapsed += 1
+                try:
+                    self.store.storage.delete(leader[0])
+                except Exception:
+                    pass  # a leaked duplicate object; gc collects it
+            leader[3].set_result(None)
+            if results is not None:
+                followers.extend((digest, m) for m in members[1:])
+            else:
+                # unregistered content: same-batch duplicates upload too
+                for m in members[1:]:
+                    self._fallback_upload(m)
+        if not followers:
+            return
+        try:
+            res = self.refs.incref(
+                [(digest, *m[4]) for digest, m in followers]
+            )
+        except Exception as e:
+            logger.warning("follower incref failed: %s", e)
+            res = [None] * len(followers)
+        for (_digest, m), r in zip(followers, res):
+            if r is not None:
+                _ELIDED.inc()
+                _ELIDED_BYTES.inc(len(m[1]))
+                self.elided += 1
+                self.elided_bytes += len(m[1])
+                m[3].set_result(None)
+            else:
+                # the row vanished between register and incref (decref-to-
+                # zero race) or meta failed: upload this copy directly
+                self._fallback_upload(m)
+
+    def _fallback_upload(self, m) -> None:
+        # pool-side upload chained to the member's future: the finalizer
+        # thread must not serialize compress+PUT inline during a meta
+        # brownout (the pool keeps follower fallbacks parallel)
+        self._passthrough(m[0], m[1], m[2], m[3])
+
+    # -- lifecycle ---------------------------------------------------------
+    def flush(self, timeout: float = 60.0) -> None:
+        """Block until every submitted block is durable (elided, uploaded
+        or staged). Every accepted block's future sits in `_outstanding`
+        from submit() until it resolves, so an empty set == drained."""
+        import time as _time
+
+        self.kick()
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if not self._outstanding:
+                    return
+            _time.sleep(0.005)
+        raise TimeoutError("ingest pipeline did not drain")
+
+    def close(self, timeout: float = 60.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self.flush(timeout)
+        finally:
+            self._batcher.close()
+            self._thread.join(timeout)
+            self._finalq.put(None)
+            self._finalizer.join(timeout)
+
+    def stats(self) -> dict:
+        return {
+            "backend": self.backend,
+            "blocks": self.blocks,
+            "put_elided": self.elided,
+            "put_elided_bytes": self.elided_bytes,
+            "uploaded": self.uploaded,
+            "passthrough": self.passthrough,
+            "race_collapsed": self.race_collapsed,
+            "errors": self.errors,
+        }

@@ -85,22 +85,47 @@ def chunk_conf(fmt: Format, args=None) -> ChunkConfig:
     return conf
 
 
-def build_store(fmt: Format, args=None, meta=None) -> CachedStore:
+def build_store(fmt: Format, args=None, meta=None,
+                with_indexer: bool = True) -> CachedStore:
     """Assemble the chunk store; with `meta` and a volume hash_backend,
     every uploaded block is fingerprinted into the meta content index
     (VERDICT r2 #3: the write-path hashing seam, role-match to the
-    reference upload hook pkg/chunk/cached_store.go:371-413)."""
+    reference upload hook pkg/chunk/cached_store.go:371-413).
+
+    Any meta-attached store also gets the content-ref plane (ISSUE 5):
+    reads resolve elided blocks through aliases and deletes decref —
+    required for correctness on any volume another --inline-dedup client
+    may have written to. The ingest elision stage itself is opt-in via
+    the mount flag. Read-only admin commands (fsck/gc/warmup) pass
+    with_indexer=False: they need alias resolution but never upload, so
+    spinning up the fingerprint worker (and possibly an accelerator
+    backend) for them would be pure startup cost."""
     conf = chunk_conf(fmt, args)
     store = CachedStore(storage_for(fmt), conf)
-    if meta is not None and fmt.hash_backend:
-        from ..chunk.indexer import BlockIndexer, pipeline_backend
+    if meta is not None:
+        from ..chunk.indexer import pipeline_backend
+        from ..chunk.ingest import ContentRefs, IngestPipeline
 
-        store.indexer = BlockIndexer(
-            meta=meta,
-            backend=pipeline_backend(fmt.hash_backend),
-            block_size=conf.block_size,
-        )
-        conf.fingerprint = store.indexer.submit
+        store.content_refs = ContentRefs(meta)
+        if fmt.hash_backend and with_indexer:
+            from ..chunk.indexer import BlockIndexer
+
+            store.indexer = BlockIndexer(
+                meta=meta,
+                backend=pipeline_backend(fmt.hash_backend),
+                block_size=conf.block_size,
+            )
+            conf.fingerprint = store.indexer.submit
+        if getattr(args, "inline_dedup", False):
+            flush_ms = getattr(args, "ingest_flush_ms", None)
+            if flush_ms is None:
+                flush_ms = 5.0  # explicit 0 means "flush immediately"
+            store.ingest = IngestPipeline(
+                store,
+                store.content_refs,
+                backend=pipeline_backend(fmt.hash_backend),
+                flush_timeout=max(0.0, float(flush_ms)) / 1e3,
+            )
     return store
 
 

@@ -32,11 +32,23 @@ def run(args) -> int:
     from . import build_store, open_meta
 
     m, fmt = open_meta(args.meta_url)
-    store = build_store(fmt, args)
+    # meta-attached store: reads of PUT-elided blocks resolve through the
+    # content-ref plane (ISSUE 5) — without it every alias is "unreadable".
+    # No indexer: fsck never uploads, and hashes through its own pipeline.
+    store = build_store(fmt, args, meta=m, with_indexer=False)
     bs = fmt.block_size * 1024
 
     stored = {o.key: o.size for o in store.storage.list_all("chunks/")}
     slices = m.list_slices()
+
+    # inline dedup (ISSUE 5): an elided block's bytes live under its
+    # canonical — existence checks must translate through the alias plane
+    try:
+        from ..chunk.ingest import alias_map
+
+        aliases = alias_map(m)
+    except Exception:
+        aliases = {}
 
     broken: list[str] = []
     checked = blocks = 0
@@ -51,9 +63,11 @@ def run(args) -> int:
                 key = block_key(s.id, i, bsize)
                 expected[key] = bsize
                 blocks += 1
-                if key not in stored:
+                if key not in stored and aliases.get(key, key) not in stored:
                     logger.error("ino %d: missing block %s", ino, key)
                     file_broken = True
+                elif key not in stored:
+                    pass  # deduped: bytes verified under the canonical key
                 elif not fmt.compression and store.compressor.name == "" and stored[key] != bsize:
                     logger.error(
                         "ino %d: block %s size %d != %d", ino, key, stored[key], bsize
@@ -82,8 +96,8 @@ def run(args) -> int:
 
         def readable():
             for key, bsize in expected.items():
-                if key not in stored:
-                    continue
+                if key not in stored and key not in aliases:
+                    continue  # reported missing above; nothing to read
                 try:
                     yield key, store._load_block(key, bsize, cache_after=False)
                 except Exception as e:

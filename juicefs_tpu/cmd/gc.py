@@ -39,7 +39,10 @@ def run(args) -> int:
     from . import build_store, open_meta
 
     m, fmt = open_meta(args.meta_url)
-    store = build_store(fmt, args)
+    # meta-attached store: dedup-scan reads of PUT-elided blocks resolve
+    # through the content-ref plane (ISSUE 5). No indexer: gc backfills
+    # digest rows itself through dedup_scan's own pipeline.
+    store = build_store(fmt, args, meta=m, with_indexer=False)
     bs = fmt.block_size * 1024
 
     if args.compact:
@@ -73,13 +76,30 @@ def run(args) -> int:
             if obj.mtime > cutoff:
                 recent.add(obj.key)
 
+    # Inline dedup (ISSUE 5): an elided block has no object of its own —
+    # its bytes live under the canonical block of its content ref. The
+    # name diff must translate through the alias plane: aliased live
+    # blocks are not "missing", and a canonical object is not "leaked"
+    # while any live alias still references it.
+    try:
+        from ..chunk.ingest import alias_map
+
+        aliases = alias_map(m)
+        protected = set(aliases.values())
+    except Exception as e:
+        logger.warning("content-ref scan unavailable: %s", e)
+        aliases, protected = {}, set()
+
     # An object can be uploaded before its slice commits to meta (the write
     # pipeline is async), so fresh objects are never "leaked" (reference gc
     # skips recent blocks for the same reason).
-    leaked = [k for k in stored if k not in live and k not in recent]
-    missing = [k for k in live if k not in stored]
+    leaked = [k for k in stored
+              if k not in live and k not in recent and k not in protected]
+    missing = [k for k in live
+               if k not in stored and aliases.get(k, k) not in stored]
     print(
-        f"scanned: {len(stored)} objects, {len(live)} live blocks, "
+        f"scanned: {len(stored)} objects, {len(live)} live blocks "
+        f"({sum(1 for k in live if k in aliases)} deduped), "
         f"{len(leaked)} leaked, {len(missing)} missing"
     )
     if missing:
@@ -97,6 +117,13 @@ def run(args) -> int:
         backend = args.hash_backend or pipeline_backend(fmt.hash_backend)
         stats = dedup_scan(m, store, live, backend, args.dedup_index, bs,
                            threads=args.threads)
+        # offline complement of the inline ingest stage: repair refcounts
+        # left by crash windows, register existing content so future
+        # writes elide, and (with --delete) collapse duplicate objects
+        # already in the store into aliases
+        stats["content_refs"] = reconcile_content_refs(
+            m, store, live, stored, collapse=args.delete, age=args.age
+        )
         print(json.dumps(stats))
     return 0
 
@@ -222,3 +249,131 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
         # handling must say so next to its throughput numbers
         "resilience": resilience_snapshot(),
     }
+
+
+def reconcile_content_refs(meta, store, live: dict[str, int],
+                           stored: dict[str, int],
+                           collapse: bool = False,
+                           age: float = 3600.0) -> dict:
+    """Offline repair + backfill for the content-ref plane (ISSUE 5) —
+    the recovery half of the inline ingest dedup contract:
+
+      1. aliases of dead blocks (elide committed, slice never did — the
+         crash window between elision and meta commit) are decref'd;
+      2. refcounts are pinned to the observed alias count;
+      3. dangling aliases (no ref row) self-heal when the block still has
+         its own object, and are REPORTED as data loss otherwise;
+      4. content already in the store is registered so future writes
+         elide against it; with collapse=True duplicate objects are
+         rewritten into aliases and deleted (the Venti-style offline
+         reclaim the inline stage cannot do retroactively).
+
+    Invariant after this runs: every alias row maps a live block to a
+    ref row whose refcount equals its alias count — zero orphaned, zero
+    dangling."""
+    import time as _time
+
+    stats = {"orphaned_aliases_repaired": 0, "refcounts_fixed": 0,
+             "dangling_content_refs": 0, "self_healed_aliases": 0,
+             "registered": 0, "collapsed": 0, "collapsed_bytes": 0}
+
+    # 1. orphaned aliases: the block is gone but its ref survived. The
+    # age cutoff mirrors the leaked-object diff's `recent` guard: a
+    # writer elides (alias committed) BEFORE its slice commits to meta,
+    # so a fresh alias absent from `live` is an in-flight acked write,
+    # not a crash orphan — repairing it would delete data mid-commit.
+    cutoff = _time.time() - age
+    aliases = list(meta.scan_content_aliases())
+    orphaned = [
+        (sid, indx) for (sid, indx), _d, bsize, ts in aliases
+        if block_key(sid, indx, bsize) not in live and ts < cutoff
+    ]
+    if orphaned:
+        for disp, canonical in meta.content_decref(orphaned):
+            if disp == "last" and canonical is not None:
+                ck = block_key(*canonical)
+                if ck not in live:
+                    try:
+                        store.storage.delete(ck)
+                    except Exception:
+                        pass
+        stats["orphaned_aliases_repaired"] = len(orphaned)
+        aliases = list(meta.scan_content_aliases())
+
+    # 2/3. refcount vs alias count; dangling aliases
+    ref_rows = {d: (canonical, refs)
+                for d, canonical, refs in meta.scan_content_refs()}
+    alias_count: dict[bytes, int] = {}
+    dangling: list[tuple[int, int]] = []
+    for (sid, indx), digest, bsize, _ts in aliases:
+        if digest in ref_rows:
+            alias_count[digest] = alias_count.get(digest, 0) + 1
+        elif block_key(sid, indx, bsize) in stored:
+            # the block still has its own object: drop the stray alias
+            meta.content_delete_aliases([(sid, indx)])
+            stats["self_healed_aliases"] += 1
+        else:
+            dangling.append((sid, indx))
+            logger.error("dangling content ref: block %s has no object "
+                         "and no canonical", block_key(sid, indx, bsize))
+    stats["dangling_content_refs"] = len(dangling)
+    for digest, (canonical, refs) in list(ref_rows.items()):
+        observed = alias_count.get(digest, 0)
+        if observed != refs:
+            meta.content_set_refs(digest, observed)
+            stats["refcounts_fixed"] += 1
+            if observed == 0:
+                ck = block_key(*canonical)
+                del ref_rows[digest]  # treated as absent below
+                if ck not in live:
+                    try:
+                        store.storage.delete(ck)
+                    except Exception:
+                        pass
+
+    # 4. backfill: register live content the inline stage never saw, so
+    # future duplicate writes elide against it; collapse rewrites
+    # already-duplicated objects into aliases and reclaims their bytes
+    aliased = {(sid, indx) for (sid, indx), _d, _b, _ts in
+               list(meta.scan_content_aliases())}
+    groups: dict[bytes, list[tuple[int, int, int]]] = {}
+    for sid, indx, bsize, digest in meta.scan_block_digests():
+        key = block_key(sid, indx, bsize)
+        if key in live and (sid, indx) not in aliased and key in stored:
+            groups.setdefault(digest, []).append((sid, indx, bsize))
+    register = []
+    collapsible: list[tuple[bytes, int, int, int]] = []
+    for digest, members in groups.items():
+        start = 0
+        if digest not in ref_rows:
+            sid, indx, bsize = members[0]
+            register.append((digest, sid, indx, bsize))
+            start = 1
+        else:
+            # a canonical whose self-alias row went missing shows up here
+            # as an unaliased member: it must NEVER be collapsed (deleting
+            # it would orphan every alias of the digest)
+            canonical = ref_rows[digest][0]
+            members = [m for m in members if m != canonical]
+            start = 0
+        collapsible.extend((digest, *m) for m in members[start:])
+    if register:
+        meta.content_register(register)
+        stats["registered"] = len(register)
+    if collapse and collapsible:
+        results = meta.content_incref(
+            [(d, sid, indx, bsize) for d, sid, indx, bsize in collapsible]
+        )
+        for (digest, sid, indx, bsize), got in zip(collapsible, results):
+            if got is None:
+                continue  # ref vanished mid-flight: leave the object alone
+            if got == (sid, indx, bsize):
+                continue  # we ARE the canonical: never delete its object
+            try:
+                store.storage.delete(block_key(sid, indx, bsize))
+            except Exception:
+                pass
+            store.cache.remove(block_key(sid, indx, bsize))
+            stats["collapsed"] += 1
+            stats["collapsed_bytes"] += bsize
+    return stats

@@ -37,6 +37,15 @@ def add_parser(sub):
     p.add_argument("--no-hedge", action="store_true",
                    help="disable hedged GETs (tail-latency duplicate "
                         "requests after the live p95)")
+    p.add_argument("--inline-dedup", action="store_true",
+                   help="hash outgoing blocks (volume hash_backend, cpu "
+                        "default) and skip compress+PUT for content the "
+                        "store already holds; overload degrades to plain "
+                        "uploads, never blocks writes (ISSUE 5)")
+    p.add_argument("--ingest-flush-ms", type=float, default=5.0,
+                   help="max time a partial ingest hash batch waits for "
+                        "more blocks before flushing (single-block write "
+                        "latency bound)")
     p.add_argument("--cache-group", default="",
                    help="join this named peer cache group: serve the local "
                         "block cache to peers and read peers' caches before "

@@ -115,7 +115,10 @@ def run(args) -> int:
     from . import build_store, open_meta
 
     m, fmt = open_meta(args.meta_url)
-    store = build_store(fmt, args)
+    # meta-attached store: warming PUT-elided blocks needs alias
+    # resolution through the content-ref plane (ISSUE 5). No indexer:
+    # warmup only reads.
+    store = build_store(fmt, args, meta=m, with_indexer=False)
     group = None
     if args.cache_group:
         group = _group_for(m, args.cache_group, args.group_self)

@@ -126,6 +126,25 @@ class BaseMeta(interface.Meta):
     def do_list_slices(self) -> dict[int, list[Slice]]: ...
     def do_counter(self, name: str, delta: int = 0) -> int: ...
 
+    # -- content-ref plane (inline ingest dedup, ISSUE 5) ------------------
+    # R{digest} -> (canonical block, size, refcount) plus per-block alias
+    # rows, kept by both engines (kv.py H/G keys, sql.py contentref/
+    # contentalias tables). Each transition is ONE transaction so a writer
+    # eliding a duplicate PUT (content_incref) and a deleter releasing the
+    # final reference (content_decref -> "last") serialize instead of
+    # racing: the loser of a decref-to-zero race simply misses the row and
+    # uploads afresh. The plane is consumed by chunk/ingest.py (write),
+    # CachedStore (read-miss alias resolution, delete decref) and
+    # cmd/gc.py --dedup (offline backfill + refcount reconciliation).
+    def content_incref(self, entries: list[tuple[bytes, int, int, int]]) -> list: ...
+    def content_register(self, entries: list[tuple[bytes, int, int, int]]) -> list: ...
+    def content_decref(self, pairs: list[tuple[int, int]]) -> list: ...
+    def content_resolve(self, sid: int, indx: int) -> Optional[tuple[int, int, int]]: ...
+    def scan_content_refs(self): ...
+    def scan_content_aliases(self): ...
+    def content_set_refs(self, digest: bytes, refs: int) -> None: ...
+    def content_delete_aliases(self, pairs: list[tuple[int, int]]) -> None: ...
+
     # -- lifecycle ---------------------------------------------------------
     def name(self) -> str:
         return "base"

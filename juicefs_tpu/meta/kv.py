@@ -18,6 +18,14 @@ the cleanest of its three engines — SURVEY.md §7.1):
                                     fingerprint plane; no reference
                                     equivalent — the reference addresses
                                     blocks by slice id only)
+    H{digest32}                  -> content ref: canonical sliceid(8) +
+                                    indx(4) + bsize(4) + refcount(i64)
+                                    (inline ingest dedup, ISSUE 5)
+    G{sliceid8}{indx4}           -> content alias: digest(32) + bsize(4) +
+                                    created(f64) — this block's bytes live
+                                    under the canonical block of H{digest};
+                                    the timestamp guards gc reconciliation
+                                    against repairing in-flight writes
     D{ino8}{length8}             -> deleted file pending data reclaim (ts f64)
     R{aclid4}                    -> interned POSIX ACL rule (insert-only;
                                     Attr.access_acl/default_acl point here)
@@ -1379,6 +1387,186 @@ class KVMeta(BaseMeta):
             def fn(tx: KVTxn, batch=batch):
                 for sid, indx in batch:
                     tx.delete(self._blockdigest_key(sid, indx))
+                return 0
+
+            self.client.txn(fn)
+
+    # ---- content-ref plane (inline ingest dedup, ISSUE 5) ----------------
+    # H{digest} rows count every block whose bytes are served by one
+    # canonical stored object; G{sid,indx} alias rows let the read and
+    # delete paths resolve a block key back to its canonical. All
+    # transitions are single transactions, so a concurrent incref (writer
+    # eliding a PUT) and decref-to-zero (deleter reclaiming the canonical)
+    # serialize: whichever commits first decides whether the other sees
+    # the row (see chunk/ingest.py for the write-path contract).
+
+    @staticmethod
+    def _contentref_key(digest: bytes) -> bytes:
+        return b"H" + digest
+
+    @staticmethod
+    def _contentalias_key(sid: int, indx: int) -> bytes:
+        return b"G" + sid.to_bytes(8, "big") + indx.to_bytes(4, "big")
+
+    @staticmethod
+    def _unpack_canonical(v: bytes) -> tuple[int, int, int]:
+        return (int.from_bytes(v[:8], "big"),
+                int.from_bytes(v[8:12], "big"),
+                int.from_bytes(v[12:16], "big"))
+
+    def _tx_add_ref(self, tx: KVTxn, rk: bytes, v: bytes,
+                    digest: bytes, sid: int, indx: int, bsize: int):
+        canonical = self._unpack_canonical(v)
+        refs = _I64.unpack_from(v, 16)[0]
+        tx.set(rk, v[:16] + _I64.pack(refs + 1))
+        tx.set(self._contentalias_key(sid, indx),
+               digest + _U32.pack(bsize) + _F64.pack(time.time()))
+        return canonical
+
+    def content_incref(
+        self, entries: list[tuple[bytes, int, int, int]]
+    ) -> list[Optional[tuple[int, int, int]]]:
+        """For each (digest, sid, indx, bsize): if a content ref exists,
+        atomically refcount+=1 and record the alias row, returning the
+        canonical (sid, indx, bsize); else None (caller must upload)."""
+
+        def fn(tx: KVTxn):
+            out: list = []
+            for digest, sid, indx, bsize in entries:
+                rk = self._contentref_key(digest)
+                v = tx.get(rk)
+                if v is None or len(v) < 24:
+                    out.append(None)
+                else:
+                    out.append(self._tx_add_ref(tx, rk, v, digest,
+                                                sid, indx, bsize))
+            return out
+
+        return self.client.txn(fn)
+
+    def content_register(
+        self, entries: list[tuple[bytes, int, int, int]]
+    ) -> list[Optional[tuple[int, int, int]]]:
+        """Register (sid, indx) as the canonical block for digest, with a
+        refcount of 1 (its own reference) and its own alias row. If the
+        digest is already registered (a concurrent writer won the race),
+        incref + alias instead and return the existing canonical so the
+        caller can collapse its redundant upload; None = registered."""
+
+        def fn(tx: KVTxn):
+            out: list = []
+            for digest, sid, indx, bsize in entries:
+                rk = self._contentref_key(digest)
+                v = tx.get(rk)
+                if v is None or len(v) < 24:
+                    tx.set(rk, sid.to_bytes(8, "big")
+                           + indx.to_bytes(4, "big")
+                           + bsize.to_bytes(4, "big") + _I64.pack(1))
+                    tx.set(self._contentalias_key(sid, indx),
+                           digest + _U32.pack(bsize) + _F64.pack(time.time()))
+                    out.append(None)
+                else:
+                    out.append(self._tx_add_ref(tx, rk, v, digest,
+                                                sid, indx, bsize))
+            return out
+
+        return self.client.txn(fn)
+
+    def content_decref(
+        self, pairs: list[tuple[int, int]]
+    ) -> list[tuple[str, Optional[tuple[int, int, int]]]]:
+        """Release (sid, indx) blocks being deleted. Per pair:
+        ("untracked", None)   — no alias row: delete the object as usual;
+        ("released", canon)   — refs remain: do NOT delete the canonical;
+        ("last", canon)       — this was the final ref: caller deletes the
+                                canonical object;
+        ("dangling", None)    — alias row without a ref row (repaired by
+                                dropping the alias; gc reports these)."""
+
+        def fn(tx: KVTxn):
+            out: list = []
+            for sid, indx in pairs:
+                ak = self._contentalias_key(sid, indx)
+                av = tx.get(ak)
+                if av is None or len(av) < 32:
+                    out.append(("untracked", None))
+                    continue
+                tx.delete(ak)
+                rk = self._contentref_key(bytes(av[:32]))
+                v = tx.get(rk)
+                if v is None or len(v) < 24:
+                    out.append(("dangling", None))
+                    continue
+                canonical = self._unpack_canonical(v)
+                refs = _I64.unpack_from(v, 16)[0]
+                if refs <= 1:
+                    tx.delete(rk)
+                    out.append(("last", canonical))
+                else:
+                    tx.set(rk, v[:16] + _I64.pack(refs - 1))
+                    out.append(("released", canonical))
+            return out
+
+        return self.client.txn(fn)
+
+    def content_resolve(self, sid: int, indx: int) -> Optional[tuple[int, int, int]]:
+        """Read path: canonical (sid, indx, bsize) serving this block's
+        bytes, or None when the block is untracked/dangling."""
+
+        def fn(tx: KVTxn):
+            av = tx.get(self._contentalias_key(sid, indx))
+            if av is None or len(av) < 32:
+                return None
+            v = tx.get(self._contentref_key(bytes(av[:32])))
+            if v is None or len(v) < 24:
+                return None
+            return self._unpack_canonical(v)
+
+        return self.client.simple_txn(fn)
+
+    def scan_content_refs(self):
+        """Yield (digest, (sid, indx, bsize), refcount) for every content
+        ref row (gc reconciliation)."""
+        for k, v in self.client.scan(b"H", next_key(b"H")):
+            if len(k) == 33 and len(v) >= 24:
+                yield (bytes(k[1:]), self._unpack_canonical(v),
+                       _I64.unpack_from(v, 16)[0])
+
+    def scan_content_aliases(self):
+        """Yield ((sid, indx), digest, bsize, created_ts) for every alias
+        row (created_ts guards reconciliation's orphan repair against
+        in-flight writes whose slice has not committed yet)."""
+        for k, v in self.client.scan(b"G", next_key(b"G")):
+            if len(k) == 13 and len(v) >= 36:
+                ts = _F64.unpack_from(v, 36)[0] if len(v) >= 44 else 0.0
+                yield ((int.from_bytes(k[1:9], "big"),
+                        int.from_bytes(k[9:13], "big")),
+                       bytes(v[:32]), _U32.unpack_from(v, 32)[0], ts)
+
+    def content_set_refs(self, digest: bytes, refs: int) -> None:
+        """gc repair: pin a ref row's count to the observed alias count
+        (refs <= 0 deletes the row)."""
+
+        def fn(tx: KVTxn):
+            rk = self._contentref_key(digest)
+            if refs <= 0:
+                tx.delete(rk)
+            else:
+                v = tx.get(rk)
+                if v is not None and len(v) >= 24:
+                    tx.set(rk, v[:16] + _I64.pack(refs))
+            return 0
+
+        self.client.txn(fn)
+
+    def content_delete_aliases(self, pairs: list[tuple[int, int]]) -> None:
+        """gc repair: drop alias rows (dangling or orphaned)."""
+        for i in range(0, len(pairs), 1024):
+            batch = pairs[i:i + 1024]
+
+            def fn(tx: KVTxn, batch=batch):
+                for sid, indx in batch:
+                    tx.delete(self._contentalias_key(sid, indx))
                 return 0
 
             self.client.txn(fn)

@@ -133,6 +133,15 @@ CREATE TABLE IF NOT EXISTS blockdigest (
 CREATE TABLE IF NOT EXISTS invalidation (
     seq INTEGER PRIMARY KEY, sid INTEGER NOT NULL,
     ts REAL NOT NULL, events TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS contentref (
+    digest BLOB PRIMARY KEY, sliceid INTEGER NOT NULL,
+    indx INTEGER NOT NULL, bsize INTEGER NOT NULL, refs INTEGER NOT NULL);
+CREATE TABLE IF NOT EXISTS contentalias (
+    sliceid INTEGER NOT NULL, indx INTEGER NOT NULL,
+    digest BLOB NOT NULL, bsize INTEGER NOT NULL,
+    created REAL NOT NULL DEFAULT 0,
+    PRIMARY KEY (sliceid, indx));
+CREATE INDEX IF NOT EXISTS contentalias_digest ON contentalias (digest);
 """
 
 _NODE_COLS = (
@@ -1489,6 +1498,154 @@ class SQLMeta(BaseMeta):
                 cur.executemany(
                     "DELETE FROM blockdigest WHERE sliceid=? AND indx=?", batch
                 )
+                return 0
+
+            self._txn(fn)
+
+    # ---- content-ref plane (inline ingest dedup, ISSUE 5) ----------------
+    # Relational mirror of the KV engine's H/G keyspace: contentref counts
+    # every block served by one canonical stored object; contentalias rows
+    # resolve a block back to its canonical for the read and delete paths.
+    # Same single-transaction transition contract as kv.py.
+
+    @staticmethod
+    def _tx_add_ref(cur, row, digest: bytes, sid: int, indx: int,
+                    bsize: int) -> tuple[int, int, int]:
+        cur.execute("UPDATE contentref SET refs=refs+1 WHERE digest=?",
+                    (digest,))
+        cur.execute(
+            "INSERT OR REPLACE INTO contentalias "
+            "(sliceid,indx,digest,bsize,created) VALUES (?,?,?,?,?)",
+            (sid, indx, digest, bsize, time.time()))
+        return (row[0], row[1], row[2])
+
+    def content_incref(
+        self, entries: list[tuple[bytes, int, int, int]]
+    ) -> list[Optional[tuple[int, int, int]]]:
+        """See KVMeta.content_incref."""
+
+        def fn(cur):
+            out: list = []
+            for digest, sid, indx, bsize in entries:
+                row = cur.execute(
+                    "SELECT sliceid, indx, bsize FROM contentref "
+                    "WHERE digest=?", (digest,)).fetchone()
+                if row is None:
+                    out.append(None)
+                else:
+                    out.append(self._tx_add_ref(cur, row, digest,
+                                                sid, indx, bsize))
+            return out
+
+        return self._txn(fn, errno_abort=False)
+
+    def content_register(
+        self, entries: list[tuple[bytes, int, int, int]]
+    ) -> list[Optional[tuple[int, int, int]]]:
+        """See KVMeta.content_register."""
+
+        def fn(cur):
+            out: list = []
+            for digest, sid, indx, bsize in entries:
+                row = cur.execute(
+                    "SELECT sliceid, indx, bsize FROM contentref "
+                    "WHERE digest=?", (digest,)).fetchone()
+                if row is None:
+                    cur.execute(
+                        "INSERT INTO contentref (digest,sliceid,indx,bsize,"
+                        "refs) VALUES (?,?,?,?,1)",
+                        (digest, sid, indx, bsize))
+                    cur.execute(
+                        "INSERT OR REPLACE INTO contentalias "
+                        "(sliceid,indx,digest,bsize,created) "
+                        "VALUES (?,?,?,?,?)",
+                        (sid, indx, digest, bsize, time.time()))
+                    out.append(None)
+                else:
+                    out.append(self._tx_add_ref(cur, row, digest,
+                                                sid, indx, bsize))
+            return out
+
+        return self._txn(fn, errno_abort=False)
+
+    def content_decref(
+        self, pairs: list[tuple[int, int]]
+    ) -> list[tuple[str, Optional[tuple[int, int, int]]]]:
+        """See KVMeta.content_decref."""
+
+        def fn(cur):
+            out: list = []
+            for sid, indx in pairs:
+                arow = cur.execute(
+                    "SELECT digest FROM contentalias "
+                    "WHERE sliceid=? AND indx=?", (sid, indx)).fetchone()
+                if arow is None:
+                    out.append(("untracked", None))
+                    continue
+                digest = bytes(arow[0])
+                cur.execute("DELETE FROM contentalias "
+                            "WHERE sliceid=? AND indx=?", (sid, indx))
+                row = cur.execute(
+                    "SELECT sliceid, indx, bsize, refs FROM contentref "
+                    "WHERE digest=?", (digest,)).fetchone()
+                if row is None:
+                    out.append(("dangling", None))
+                    continue
+                canonical = (row[0], row[1], row[2])
+                if row[3] <= 1:
+                    cur.execute("DELETE FROM contentref WHERE digest=?",
+                                (digest,))
+                    out.append(("last", canonical))
+                else:
+                    cur.execute("UPDATE contentref SET refs=refs-1 "
+                                "WHERE digest=?", (digest,))
+                    out.append(("released", canonical))
+            return out
+
+        return self._txn(fn, errno_abort=False)
+
+    def content_resolve(self, sid: int, indx: int) -> Optional[tuple[int, int, int]]:
+        """See KVMeta.content_resolve."""
+        row = self._rtxn(lambda cur: cur.execute(
+            "SELECT r.sliceid, r.indx, r.bsize FROM contentalias a "
+            "JOIN contentref r ON r.digest = a.digest "
+            "WHERE a.sliceid=? AND a.indx=?", (sid, indx)).fetchone())
+        return (row[0], row[1], row[2]) if row is not None else None
+
+    def scan_content_refs(self):
+        rows = self._rtxn(lambda cur: cur.execute(
+            "SELECT digest, sliceid, indx, bsize, refs FROM contentref "
+            "ORDER BY sliceid, indx").fetchall())
+        for digest, sid, indx, bsize, refs in rows:
+            yield bytes(digest), (sid, indx, bsize), refs
+
+    def scan_content_aliases(self):
+        """See KVMeta.scan_content_aliases (4th element = created_ts)."""
+        rows = self._rtxn(lambda cur: cur.execute(
+            "SELECT sliceid, indx, digest, bsize, created FROM contentalias "
+            "ORDER BY sliceid, indx").fetchall())
+        for sid, indx, digest, bsize, created in rows:
+            yield (sid, indx), bytes(digest), bsize, created
+
+    def content_set_refs(self, digest: bytes, refs: int) -> None:
+        def fn(cur):
+            if refs <= 0:
+                cur.execute("DELETE FROM contentref WHERE digest=?", (digest,))
+            else:
+                cur.execute("UPDATE contentref SET refs=? WHERE digest=?",
+                            (refs, digest))
+            return 0
+
+        self._txn(fn)
+
+    def content_delete_aliases(self, pairs: list[tuple[int, int]]) -> None:
+        for i in range(0, len(pairs), 1024):
+            batch = pairs[i:i + 1024]
+
+            def fn(cur, batch=batch):
+                cur.executemany(
+                    "DELETE FROM contentalias WHERE sliceid=? AND indx=?",
+                    batch)
                 return 0
 
             self._txn(fn)

@@ -133,7 +133,18 @@ def jth256_batch(blocks: Sequence[bytes], threads: int = 0) -> list[bytes]:
     if threads <= 0:
         threads = min(len(blocks), os.cpu_count() or 1)
     n = len(blocks)
-    arr = (ctypes.c_char_p * n)(*blocks)
+    # zero-copy pointers for bytes AND writable buffers (bytearray from
+    # the WSlice block buffers — the ingest path hashes them in place;
+    # the C side only reads, bounded by the explicit lengths)
+    arr = (ctypes.c_char_p * n)()
+    _keepalive = []
+    for i, b in enumerate(blocks):
+        if isinstance(b, bytes):
+            arr[i] = b
+        else:
+            view = (ctypes.c_char * len(b)).from_buffer(b)
+            _keepalive.append(view)
+            arr[i] = ctypes.cast(view, ctypes.c_char_p)
     lens = (ctypes.c_size_t * n)(*[len(b) for b in blocks])
     outs = ctypes.create_string_buffer(32 * n)
     lib.jfs_jth256_batch(

@@ -13,6 +13,7 @@ Backend selection mirrors the reference's Compressor registry pattern
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Iterable, Iterator, Optional
 
@@ -148,3 +149,93 @@ class HashPipeline:
 
     def hash_blocks(self, blocks: Iterable[bytes]) -> list[bytes]:
         return [d for _, d in self.hash_stream((str(i), b) for i, b in enumerate(blocks))]
+
+
+_FLUSH = object()  # kick(): hash whatever is buffered NOW (commit barrier)
+_CLOSE = object()
+
+
+class HashBatcher:
+    """Bounded-queue accumulator in front of a HashPipeline (flush-timeout
+    mode, ISSUE 5).
+
+    The pipeline wants device-sized batches (batch_blocks × block_size per
+    dispatch) but the ingest path produces blocks one upload at a time, and
+    a writer's commit barrier (`WSlice.finish`) may be waiting on a single
+    block. The batcher bridges the two rates: producers `submit()` without
+    ever blocking (a full queue returns False — overload is the caller's
+    degrade signal, mirroring chunk/indexer.py's drop contract), and the
+    consumer pulls batches that are flushed by whichever comes first —
+
+      - the batch filled (`batch_blocks`),
+      - `flush_timeout` expired since the batch's first block (a lone
+        block never waits out a full batch window), or
+      - `kick()` — a commit barrier is waiting; hash what we have NOW.
+    """
+
+    def __init__(self, pipe: HashPipeline, queue_blocks: int = 64,
+                 flush_timeout: float = 0.005):
+        import queue as _queue
+
+        self.pipe = pipe
+        self.flush_timeout = flush_timeout
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(1, queue_blocks))
+        self._empty = _queue.Empty
+        self._closed = False
+
+    def submit(self, item) -> bool:
+        """Producer side; returns False when the hash plane is saturated
+        (queue full) or the batcher is closed (an item enqueued behind
+        the close sentinel would never be consumed) — the caller
+        degrades, it never blocks here."""
+        if self._closed:
+            return False
+        try:
+            self._q.put_nowait(item)
+            return True
+        except Exception:
+            return False
+
+    def kick(self) -> None:
+        """Flush the current partial batch immediately. Non-blocking by
+        contract (a commit barrier calls this): when the queue is full
+        the marker is simply dropped — a full queue means the consumer is
+        saturated and the batch flushes on size or timeout anyway."""
+        try:
+            self._q.put_nowait(_FLUSH)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._closed = True
+        self._q.put(_CLOSE)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def batches(self) -> Iterator[list]:
+        """Consumer side: yield non-empty item batches until close()."""
+        batch_blocks = max(1, self.pipe.config.batch_blocks)
+        while True:
+            item = self._q.get()
+            if item is _CLOSE:
+                return
+            if item is _FLUSH:
+                continue
+            batch = [item]
+            deadline = time.monotonic() + self.flush_timeout
+            while len(batch) < batch_blocks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except self._empty:
+                    break
+                if nxt is _CLOSE:
+                    yield batch
+                    return
+                if nxt is _FLUSH:
+                    break
+                batch.append(nxt)
+            yield batch

@@ -215,16 +215,22 @@ class InternalFiles:
         and the writeback/degraded staging backlog."""
         from ..object.resilient import resilience_snapshot
 
+        from ..chunk.cached_store import _staged_len
+
         store = self.vfs.store
         health = getattr(store.storage, "health", None)
         with store._pending_lock:
             staged_blocks = len(store._pending_staged)
-            staged_bytes = sum(len(v) for v in store._pending_staged.values())
+            # entries past the RAM cap are spilled refs, not bytes
+            staged_bytes = sum(_staged_len(v)
+                               for v in store._pending_staged.values())
+            staged_mem = store._staged_mem
         out = {
             "object_plane": health() if callable(health) else {
                 "resilient": False},
             "degraded": bool(getattr(store, "degraded", False)),
-            "staging": {"blocks": staged_blocks, "bytes": staged_bytes},
+            "staging": {"blocks": staged_blocks, "bytes": staged_bytes,
+                        "mem_bytes": staged_mem},
             "resilience_counters": resilience_snapshot(),
         }
         group = getattr(store, "cache_group", None)

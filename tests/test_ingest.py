@@ -1,0 +1,731 @@
+"""Inline ingest dedup drills (ISSUE 5): TPU-hashed PUT elision on the
+write path, the content-ref plane's refcount invariants under concurrency
+and crashes, and the bounded staged-memory satellite.
+
+The load-bearing assertions:
+  - duplicate blocks cause ZERO backend PUTs (counter-asserted on a
+    counting storage wrapper, not inferred from throughput);
+  - refcounts stay exact under two concurrent writers of identical
+    content and under delete-vs-dedup races (both serialization orders);
+  - the crash window between elision and slice commit is repaired by
+    `gc --dedup` reconciliation (zero orphaned / zero dangling after);
+  - deduped data reads back byte-identical on BOTH meta engines.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from juicefs_tpu.chunk import CachedStore, ChunkConfig, ContentRefs, IngestPipeline
+from juicefs_tpu.chunk.cached_store import block_key
+from juicefs_tpu.cmd.gc import reconcile_content_refs
+from juicefs_tpu.meta import new_client
+from juicefs_tpu.meta.types import Format
+from juicefs_tpu.object import create_storage
+
+BS = 1 << 18  # 256 KiB blocks keep the drills fast
+
+
+class CountingStore:
+    """Backend wrapper recording PUT/DELETE keys (counter-assertions)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.put_keys: list[str] = []
+        self.deleted: list[str] = []
+        self.lock = threading.Lock()
+
+    def put(self, key, data):
+        with self.lock:
+            self.put_keys.append(key)
+        return self._inner.put(key, data)
+
+    def delete(self, key):
+        with self.lock:
+            self.deleted.append(key)
+        return self._inner.delete(key)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@pytest.fixture(params=["memkv", "sqlite3"])
+def meta(request, tmp_path):
+    if request.param == "memkv":
+        uri = "memkv://ingest-test"
+    else:
+        uri = f"sqlite3://{tmp_path}/meta.db"
+    m = new_client(uri)
+    m.init(Format(name="t", trash_days=0, block_size=BS >> 10), force=True)
+    m.load()
+    yield m
+    if request.param == "memkv":
+        m.client.reset()
+
+
+@pytest.fixture
+def vol(meta, tmp_path):
+    storage = create_storage(f"file://{tmp_path}/blob")
+    storage.create()
+    counting = CountingStore(storage)
+    store = CachedStore(counting, ChunkConfig(block_size=BS, cache_size=1))
+    refs = ContentRefs(meta)
+    store.content_refs = refs
+    store.ingest = IngestPipeline(store, refs, backend="cpu",
+                                  batch_blocks=8, flush_timeout=0.005)
+    yield meta, store, counting
+    store.close()
+
+
+def _write(store, sid: int, *blocks: bytes) -> None:
+    w = store.new_writer(sid)
+    for j, b in enumerate(blocks):
+        w.write_at(b, j * BS)
+    w.finish(len(blocks) * BS)
+
+
+def _cold_reader(meta, counting, tmp_path=None):
+    cold = CachedStore(counting, ChunkConfig(block_size=BS, cache_size=1))
+    cold.content_refs = ContentRefs(meta)
+    return cold
+
+
+def _live(slices: dict[int, int]) -> dict[str, int]:
+    """{sid: n_blocks} -> the live block map gc builds."""
+    return {
+        block_key(sid, j, BS): BS
+        for sid, n in slices.items() for j in range(n)
+    }
+
+
+def _stored(counting) -> dict[str, int]:
+    return {o.key: o.size for o in counting.list_all("chunks/")}
+
+
+def test_duplicate_puts_elided_and_readback_identical(vol):
+    meta, store, counting = vol
+    dup = os.urandom(BS)
+    uniq = [os.urandom(BS) for _ in range(3)]
+    _write(store, 1, dup, uniq[0])
+    _write(store, 2, dup, uniq[1])   # block 0 is a duplicate
+    _write(store, 3, uniq[2], dup)   # block 1 is a duplicate
+    store.ingest.flush()
+
+    st = store.ingest.stats()
+    assert st["put_elided"] == 2 and st["errors"] == 0
+    # counter-asserted: the duplicate block keys saw ZERO backend PUTs
+    dup_keys = {block_key(2, 0, BS), block_key(3, 1, BS)}
+    assert not dup_keys & set(counting.put_keys)
+    assert len(counting.put_keys) == 4  # dup once + 3 uniques
+
+    # cold read-back (fresh store, empty cache) is byte-identical,
+    # including the aliased blocks resolved through the content-ref plane
+    cold = _cold_reader(meta, counting)
+    try:
+        for sid, blocks in ((1, [dup, uniq[0]]), (2, [dup, uniq[1]]),
+                            (3, [uniq[2], dup])):
+            r = cold.new_reader(sid, len(blocks) * BS)
+            for j, want in enumerate(blocks):
+                assert bytes(r.read(j * BS, BS)) == want
+            # ranged read through the alias too (small-read shortcut)
+            assert bytes(r.read(7, 100)) == blocks[0][7:107]
+    finally:
+        cold.close()
+
+
+def test_refcounts_exact_under_concurrent_identical_writers(vol):
+    meta, store, counting = vol
+    dup = os.urandom(BS)
+    n_writers, per_writer = 4, 6
+    barrier = threading.Barrier(n_writers)
+    errs: list = []
+
+    def writer(base_sid: int):
+        try:
+            barrier.wait()
+            for k in range(per_writer):
+                _write(store, base_sid + k, dup)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(100 * (i + 1),))
+               for i in range(n_writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    store.ingest.flush()
+    assert not errs
+
+    # exactly one canonical object; every other write elided or collapsed
+    total = n_writers * per_writer
+    st = store.ingest.stats()
+    assert st["put_elided"] + st["uploaded"] + st["passthrough"] == total
+    refs = list(meta.scan_content_refs())
+    assert len(refs) == 1
+    _digest, _canonical, refcount = refs[0]
+    aliases = list(meta.scan_content_aliases())
+    # the refcount invariant: ref row counts exactly the alias rows
+    assert refcount == len(aliases)
+    # every block reads back identical through a cold store
+    cold = _cold_reader(meta, counting)
+    try:
+        for i in range(n_writers):
+            for k in range(per_writer):
+                sid = 100 * (i + 1) + k
+                assert bytes(cold.new_reader(sid, BS).read(0, BS)) == dup
+    finally:
+        cold.close()
+    # reconciliation finds nothing to repair
+    live = _live({100 * (i + 1) + k: 1
+                  for i in range(n_writers) for k in range(per_writer)})
+    rep = reconcile_content_refs(meta, store, live, _stored(counting))
+    assert rep["orphaned_aliases_repaired"] == 0
+    assert rep["dangling_content_refs"] == 0
+    assert rep["refcounts_fixed"] == 0
+
+
+def test_delete_vs_dedup_race_decref_wins(vol):
+    """Deleter decrefs to zero BEFORE the writer's incref commits: the
+    row is gone, the writer must miss and upload afresh."""
+    meta, store, counting = vol
+    dup = os.urandom(BS)
+    _write(store, 1, dup)
+    store.ingest.flush()
+    store.remove(1, BS)  # decref to zero: canonical object reclaimed
+    assert list(meta.scan_content_refs()) == []
+    _write(store, 2, dup)  # incref misses -> fresh upload
+    store.ingest.flush()
+    assert store.ingest.stats()["uploaded"] == 2
+    cold = _cold_reader(meta, counting)
+    try:
+        assert bytes(cold.new_reader(2, BS).read(0, BS)) == dup
+    finally:
+        cold.close()
+
+
+def test_delete_vs_dedup_race_incref_wins(vol):
+    """Writer increfs BEFORE the deleter: the canonical's own slice dies
+    but its object must survive for the alias, then reclaim on last ref."""
+    meta, store, counting = vol
+    dup = os.urandom(BS)
+    _write(store, 1, dup)   # canonical
+    _write(store, 2, dup)   # alias (elided)
+    store.ingest.flush()
+    canonical = block_key(1, 0, BS)
+    store.remove(1, BS)     # released: object must SURVIVE
+    assert canonical in _stored(counting)
+    cold = _cold_reader(meta, counting)
+    try:
+        assert bytes(cold.new_reader(2, BS).read(0, BS)) == dup
+    finally:
+        cold.close()
+    store.remove(2, BS)     # last ref: NOW the canonical is reclaimed
+    assert canonical not in _stored(counting)
+    assert list(meta.scan_content_refs()) == []
+    assert list(meta.scan_content_aliases()) == []
+
+
+def test_delete_vs_dedup_churn_reconciles_clean(vol):
+    """Hammer writers (duplicate content) against deleters, then assert
+    the acceptance invariant: reconciliation reports zero orphaned and
+    zero dangling content refs, and every surviving block reads back."""
+    meta, store, counting = vol
+    pool = [os.urandom(BS) for _ in range(3)]
+    alive: dict[int, int] = {}
+    lock = threading.Lock()
+    stop = threading.Event()
+    errs: list = []
+
+    def writer(base: int):
+        try:
+            for k in range(30):
+                sid = base + k
+                data = pool[k % len(pool)]
+                _write(store, sid, data)
+                with lock:
+                    alive[sid] = k % len(pool)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def deleter():
+        try:
+            while not stop.is_set():
+                with lock:
+                    sids = list(alive)
+                if len(sids) > 4:
+                    victim = sids[len(sids) // 2]
+                    with lock:
+                        alive.pop(victim, None)
+                    store.remove(victim, BS)
+                time.sleep(0.001)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(1000 * (i + 1),))
+               for i in range(3)]
+    killer = threading.Thread(target=deleter)
+    for t in threads:
+        t.start()
+    killer.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    killer.join()
+    store.ingest.flush()
+    assert not errs
+
+    live = _live({sid: 1 for sid in alive})
+    rep = reconcile_content_refs(meta, store, live, _stored(counting))
+    assert rep["orphaned_aliases_repaired"] == 0
+    assert rep["dangling_content_refs"] == 0
+    assert rep["refcounts_fixed"] == 0
+    cold = _cold_reader(meta, counting)
+    try:
+        for sid, pi in alive.items():
+            assert bytes(cold.new_reader(sid, BS).read(0, BS)) == pool[pi], sid
+    finally:
+        cold.close()
+
+
+def test_crash_window_between_elide_and_slice_commit(vol):
+    """A block elides (incref txn committed) but the client dies before
+    its slice commits to meta: the alias is orphaned. gc --dedup
+    reconciliation decrefs it; a second pass reports nothing."""
+    meta, store, counting = vol
+    dup = os.urandom(BS)
+    _write(store, 1, dup)
+    _write(store, 2, dup)   # elided; pretend slice 2 never commits
+    store.ingest.flush()
+    assert len(list(meta.scan_content_aliases())) == 2
+
+    live = _live({1: 1})  # slice 2 missing = the crash
+    # default age: a FRESH not-yet-committed alias must NOT be repaired
+    # (it is indistinguishable from an in-flight acked write)
+    rep0 = reconcile_content_refs(meta, store, live, _stored(counting))
+    assert rep0["orphaned_aliases_repaired"] == 0
+    # past the age cutoff it is a real crash orphan: decref'd
+    rep = reconcile_content_refs(meta, store, live, _stored(counting),
+                                 age=0.0)
+    assert rep["orphaned_aliases_repaired"] == 1
+    refs = list(meta.scan_content_refs())
+    assert len(refs) == 1 and refs[0][2] == 1  # back to the canonical's own ref
+    # second pass: invariant restored, nothing to repair
+    rep2 = reconcile_content_refs(meta, store, live, _stored(counting),
+                                  age=0.0)
+    assert rep2 == {k: 0 for k in rep2}
+    cold = _cold_reader(meta, counting)
+    try:
+        assert bytes(cold.new_reader(1, BS).read(0, BS)) == dup
+    finally:
+        cold.close()
+
+
+def test_crash_window_orphaned_last_ref_reclaims_object(vol):
+    """Crash-window alias is the LAST reference (its canonical's slice
+    already deleted): reconciliation must reclaim the object too."""
+    meta, store, counting = vol
+    dup = os.urandom(BS)
+    _write(store, 1, dup)
+    _write(store, 2, dup)
+    store.ingest.flush()
+    store.remove(1, BS)  # canonical slice gone; alias 2 holds the object
+    canonical = block_key(1, 0, BS)
+    assert canonical in _stored(counting)
+    live: dict[str, int] = {}  # slice 2 never committed either
+    rep = reconcile_content_refs(meta, store, live, _stored(counting),
+                                 age=0.0)
+    assert rep["orphaned_aliases_repaired"] == 1
+    assert canonical not in _stored(counting)
+    assert list(meta.scan_content_refs()) == []
+
+
+def test_gc_offline_collapse_dedups_existing_volume(vol):
+    """`gc --dedup --delete` as the offline complement: content written
+    WITHOUT inline dedup is registered, duplicate objects are rewritten
+    into aliases and deleted, and reads stay byte-identical."""
+    meta, store, counting = vol
+    store.ingest.close()
+    store.ingest = None  # plain writes: every block PUTs
+    dup = os.urandom(BS)
+    _write(store, 1, dup)
+    _write(store, 2, dup)
+    _write(store, 3, dup)
+    store.flush_all()
+    assert len(_stored(counting)) == 3
+    # backfill needs the digest index (the write path's fingerprint hook
+    # isn't wired in this bare-store fixture): hash as gc's scan would
+    from juicefs_tpu.tpu.jth256 import jth256
+
+    meta.set_block_digests(
+        [(sid, 0, BS, jth256(dup)) for sid in (1, 2, 3)]
+    )
+    live = _live({1: 1, 2: 1, 3: 1})
+    rep = reconcile_content_refs(meta, store, live, _stored(counting),
+                                 collapse=True)
+    assert rep["registered"] == 1
+    assert rep["collapsed"] == 2
+    assert rep["collapsed_bytes"] == 2 * BS
+    assert len(_stored(counting)) == 1  # two duplicate objects reclaimed
+    cold = _cold_reader(meta, counting)
+    try:
+        for sid in (1, 2, 3):
+            assert bytes(cold.new_reader(sid, BS).read(0, BS)) == dup
+    finally:
+        cold.close()
+    # refcount invariant holds after the collapse
+    rep2 = reconcile_content_refs(meta, store, live, _stored(counting))
+    assert rep2["orphaned_aliases_repaired"] == 0
+    assert rep2["dangling_content_refs"] == 0
+    assert rep2["refcounts_fixed"] == 0
+
+
+def test_same_batch_duplicates_elide_via_followers(vol):
+    """Duplicates of content first seen in the SAME hash batch: one
+    leader uploads+registers, the followers incref in one txn — still
+    zero backend PUTs for the duplicates."""
+    meta, store, counting = vol
+    dup, uniq = os.urandom(BS), os.urandom(BS)
+    _write(store, 1, dup, dup, uniq, dup, dup)  # one 5-block slice/batch
+    store.ingest.flush()
+    st = store.ingest.stats()
+    assert st["put_elided"] == 3 and st["uploaded"] == 2, st
+    assert len(counting.put_keys) == 2
+    refs = list(meta.scan_content_refs())
+    assert sorted(r for _, _, r in refs) == [1, 4]
+    cold = _cold_reader(meta, counting)
+    try:
+        r = cold.new_reader(1, 5 * BS)
+        for j, want in enumerate((dup, dup, uniq, dup, dup)):
+            assert bytes(r.read(j * BS, BS)) == want
+    finally:
+        cold.close()
+
+
+def test_leader_put_failure_fails_the_whole_group(vol):
+    """A failed canonical PUT must propagate to every member's commit
+    barrier — same-batch followers must not report durable."""
+    meta, store, counting = vol
+    boom = IOError("backend exploded")
+    orig = store._put_block
+
+    def bad_put(key, raw, parent=None, fingerprint=True):
+        raise boom
+
+    store._put_block = bad_put
+    dup = os.urandom(BS)
+    w = store.new_writer(1)
+    w.write_at(dup, 0)
+    w.write_at(dup, BS)
+    with pytest.raises(IOError, match="backend exploded"):
+        w.finish(2 * BS)
+    store._put_block = orig
+    assert counting.put_keys == []
+    assert list(meta.scan_content_refs()) == []  # nothing half-registered
+
+
+def test_register_failure_keeps_followers_durable(vol):
+    """Meta down AFTER the canonical PUT: the leader is durable but
+    unregistered, and same-batch followers must fall back to their own
+    uploads — no data may ride an alias that never committed."""
+    meta, store, counting = vol
+
+    def broken_register(entries):
+        raise RuntimeError("meta down")
+
+    store.ingest.refs.register = broken_register
+    dup = os.urandom(BS)
+    _write(store, 1, dup, dup)   # same-batch duplicate
+    store.ingest.flush()
+    st = store.ingest.stats()
+    assert st["errors"] >= 1 and st["put_elided"] == 0
+    # both blocks have their own objects (follower fell back to upload)
+    assert set(counting.put_keys) == {block_key(1, 0, BS),
+                                      block_key(1, 1, BS)}
+    cold = _cold_reader(meta, counting)
+    try:
+        r = cold.new_reader(1, 2 * BS)
+        assert bytes(r.read(0, BS)) == dup
+        assert bytes(r.read(BS, BS)) == dup
+    finally:
+        cold.close()
+
+
+def test_ingest_pipeline_pad_matches_block_size(vol):
+    """The hash pipeline's pad geometry must track the store's block
+    size, or device backends would reject (or silently over-pad) every
+    batch."""
+    from juicefs_tpu.tpu.jth256 import LANE_BYTES
+
+    _meta, store, _counting = vol
+    cfg = store.ingest._batcher.pipe.config
+    assert cfg.pad_lanes == max(1, store.conf.block_size // 65536)
+    assert cfg.pad_lanes * LANE_BYTES >= store.conf.block_size
+
+
+def test_overload_degrades_to_passthrough_without_blocking(vol):
+    """Zhu et al. FAST '08 contract: a saturated hash plane must never
+    throttle ingest. Writes keep completing (passthrough PUTs) and stay
+    byte-identical."""
+    meta, store, counting = vol
+    store.ingest.close()
+    store.ingest = IngestPipeline(store, ContentRefs(meta), backend="cpu",
+                                  batch_blocks=4, queue_blocks=4,
+                                  flush_timeout=0.005)
+    real = store.ingest._batcher.pipe.hash_blocks
+
+    def slow(blocks):
+        time.sleep(0.05)
+        return real(blocks)
+
+    store.ingest._batcher.pipe.hash_blocks = slow
+    datas = [os.urandom(BS) for _ in range(24)]
+    t0 = time.perf_counter()
+    futs = [store.ingest.submit(block_key(10 + i, 0, BS), d)
+            for i, d in enumerate(datas)]
+    elapsed = time.perf_counter() - t0
+    # 24 blocks at 50ms/4-batch = 300ms of hash stalls if submit()
+    # blocked; the passthrough path keeps the producer at memcpy speed
+    assert elapsed < 0.25, f"submit path blocked for {elapsed:.2f}s"
+    store.ingest.flush(timeout=30)
+    for f in futs:
+        assert f.exception() is None
+    st = store.ingest.stats()
+    assert st["passthrough"] > 0, st
+    assert st["blocks"] == 24
+    cold = _cold_reader(meta, counting)
+    try:
+        for i, d in enumerate(datas):
+            assert bytes(cold.new_reader(10 + i, BS).read(0, BS)) == d
+    finally:
+        cold.close()
+
+
+def test_staged_memory_spills_past_cap(tmp_path):
+    """Satellite: _pending_staged must not pin unbounded raw bytes during
+    an outage/writeback backlog — entries past the cap keep only their
+    staging-file path and replay re-reads them byte-identical."""
+    storage = create_storage(f"file://{tmp_path}/blob")
+    storage.create()
+    counting = CountingStore(storage)
+    store = CachedStore(counting, ChunkConfig(
+        block_size=BS, cache_dirs=(str(tmp_path / "cache"),),
+        writeback=True, staged_mem_bytes=2 * BS))
+    try:
+        datas = [os.urandom(BS) for _ in range(8)]
+        # stall uploads so the staging backlog builds
+        orig = store._put_block
+        gate = threading.Event()
+
+        def slow_put(key, raw, parent=None, fingerprint=True):
+            gate.wait(5.0)
+            return orig(key, raw, parent, fingerprint)
+
+        store._put_block = slow_put
+        for i, d in enumerate(datas):
+            _write(store, 50 + i, d)
+        # backlog present; RAM pinned below cap + one in-flight block
+        with store._pending_lock:
+            pinned = store._staged_mem
+            backlog = len(store._pending_staged)
+        assert backlog > 0
+        assert pinned <= 3 * BS, f"staged RAM not bounded: {pinned}"
+        # staged reads still serve the spilled blocks byte-identically
+        assert bytes(store.new_reader(57, BS).read(0, BS)) == datas[7]
+        gate.set()
+        store.flush_all(timeout=30)
+        # replay re-read the spilled files and uploaded every block
+        for i, d in enumerate(datas):
+            key = block_key(50 + i, 0, BS)
+            assert key in _stored(counting)
+            assert bytes(storage.get(key)) == d
+    finally:
+        store.close()
+
+
+def test_alias_map_excludes_self_and_maps_to_canonical(vol):
+    """gc/fsck translate name sweeps through alias_map: it must map every
+    elided block to its canonical and NEVER include self-entries (a
+    canonical mapping to itself would hide real missing objects)."""
+    from juicefs_tpu.chunk.ingest import alias_map
+
+    meta, store, _counting = vol
+    dup = os.urandom(BS)
+    _write(store, 1, dup)
+    _write(store, 2, dup)
+    store.ingest.flush()
+    amap = alias_map(meta)
+    assert amap == {block_key(2, 0, BS): block_key(1, 0, BS)}
+
+
+def test_release_handles_foreign_and_mixed_keys(vol):
+    """ContentRefs.release must pass through unparseable keys as
+    untracked (position-aligned with the input) and decref real ones."""
+    meta, store, _counting = vol
+    dup = os.urandom(BS)
+    _write(store, 1, dup)
+    _write(store, 2, dup)
+    store.ingest.flush()
+    refs = store.content_refs
+    assert refs.release(["not-a-block-key"]) == [("untracked", None)]
+    out = refs.release(["junk", block_key(2, 0, BS), "more-junk"])
+    assert out[0] == ("untracked", None)
+    assert out[1] == ("released", block_key(1, 0, BS))
+    assert out[2] == ("untracked", None)
+
+
+def test_breaker_open_mid_ingest_stages_whole_group(vol):
+    """Canonical PUT hits an OPEN breaker: the whole miss group (leader
+    AND same-batch followers) degrades to staging — futures resolve (the
+    write is acked), nothing is registered, replay uploads raw bytes."""
+    from juicefs_tpu.object.resilient import BreakerOpenError
+
+    meta, store, counting = vol
+    orig = store._put_block
+    calls = {"n": 0}
+
+    def tripping(key, raw, parent=None, fingerprint=True):
+        calls["n"] += 1
+        raise BreakerOpenError("open")
+
+    store._put_block = tripping
+    dup = os.urandom(BS)
+    _write(store, 1, dup, dup)  # leader + follower, same batch
+    store.ingest.flush()
+    assert calls["n"] >= 1
+    with store._pending_lock:
+        staged = set(store._pending_staged)
+    assert staged == {block_key(1, 0, BS), block_key(1, 1, BS)}
+    assert list(meta.scan_content_refs()) == []  # no aliasing mid-outage
+    store._put_block = orig
+    store._replay_staged()
+    store.flush_all(timeout=30)
+    assert set(counting.put_keys) == staged  # replay uploaded both
+
+
+def test_follower_incref_failure_falls_back_to_upload(vol):
+    """The decref-to-zero race window: the registered row vanishes (or
+    meta fails) between the leader's register and the followers' incref —
+    followers must upload their own copies, never ride a dead alias."""
+    meta, store, counting = vol
+    real = store.ingest.refs.incref
+    state = {"calls": 0}
+
+    def flaky(entries):
+        state["calls"] += 1
+        if state["calls"] >= 2:  # first call = batch lookup, then fail
+            raise RuntimeError("meta blinked")
+        return real(entries)
+
+    store.ingest.refs.incref = flaky
+    dup = os.urandom(BS)
+    _write(store, 1, dup, dup)  # same-batch follower needs incref
+    store.ingest.flush()
+    store.ingest.refs.incref = real
+    assert state["calls"] >= 2
+    # both objects exist: leader PUT + follower fallback PUT
+    assert set(counting.put_keys) == {block_key(1, 0, BS),
+                                      block_key(1, 1, BS)}
+    cold = _cold_reader(meta, counting)
+    try:
+        r = cold.new_reader(1, 2 * BS)
+        assert bytes(r.read(0, BS)) == dup
+        assert bytes(r.read(BS, BS)) == dup
+    finally:
+        cold.close()
+
+
+def test_fsck_and_gc_cli_resolve_aliases(tmp_path, capsys):
+    """The offline CLIs must build a meta-attached store: without the
+    content-ref plane every PUT-elided block is 'unreadable'/'missing'
+    (caught live on a --inline-dedup mount drive)."""
+    import json
+
+    from juicefs_tpu.cmd import build_store, main, open_meta
+    from juicefs_tpu.meta.context import Context
+    from juicefs_tpu.vfs import ROOT_INO, VFS
+
+    ctx = Context(uid=0, gid=0, pid=1)
+    meta_url = f"sqlite3://{tmp_path}/meta.db"
+    assert main(["format", meta_url, "dvol", "--storage", "file",
+                 "--bucket", str(tmp_path / "blobs"), "--block-size", "256",
+                 "--hash-backend", "cpu", "--trash-days", "0"]) == 0
+
+    class A:
+        cache_dir = str(tmp_path / "cache")
+        writeback = False
+        cache_size = 0
+        inline_dedup = True
+
+    m, fmt = open_meta(meta_url)
+    m.new_session()
+    store = build_store(fmt, A(), meta=m)
+    assert store.ingest is not None  # the mount flag wired the stage
+    v = VFS(m, store, fmt=fmt)
+    blob = os.urandom(262144)
+    for name in (b"a.bin", b"b.bin"):
+        st, ino, _, fh = v.create(ctx, ROOT_INO, name, 0o644)
+        assert st == 0
+        assert v.write(ctx, ino, fh, 0, blob) == 0
+        assert v.release(ctx, ino, fh) == 0
+    store.flush_all()
+    assert store.ingest.stats()["put_elided"] == 1
+    v.close()
+    capsys.readouterr()
+
+    # fsck reads the elided block through its canonical: zero broken
+    assert main(["fsck", meta_url, "--verify-data"]) == 0
+    out = capsys.readouterr().out
+    assert "0 broken" in out
+    # gc sees the alias as deduped, not missing; reconciliation is clean
+    assert main(["gc", meta_url, "--dedup", "--age", "0"]) == 0
+    out = capsys.readouterr().out
+    assert "0 leaked, 0 missing" in out
+    stats = json.loads(out.strip().splitlines()[-1])
+    assert stats["content_refs"]["dangling_content_refs"] == 0
+    assert stats["content_refs"]["orphaned_aliases_repaired"] == 0
+
+
+def test_hash_batcher_flush_timeout_and_kick():
+    from juicefs_tpu.tpu.pipeline import HashBatcher, HashPipeline, PipelineConfig
+
+    hb = HashBatcher(HashPipeline(PipelineConfig(backend="cpu",
+                                                 batch_blocks=4)),
+                     queue_blocks=8, flush_timeout=10.0)
+    out: list = []
+    t = threading.Thread(target=lambda: out.extend(hb.batches()))
+    t.start()
+    # kick flushes a partial batch long before the 10s timeout
+    assert hb.submit("a")
+    hb.kick()
+    time.sleep(0.2)
+    assert out and out[0] == ["a"]
+    # a full batch flushes without any kick
+    for x in "bcde":
+        hb.submit(x)
+    time.sleep(0.2)
+    assert out[1] == list("bcde")
+    hb.close()
+    t.join(5.0)
+    assert not t.is_alive()
+
+
+def test_hash_batcher_flush_timeout_bounds_latency():
+    from juicefs_tpu.tpu.pipeline import HashBatcher, HashPipeline, PipelineConfig
+
+    hb = HashBatcher(HashPipeline(PipelineConfig(backend="cpu",
+                                                 batch_blocks=64)),
+                     flush_timeout=0.02)
+    out: list = []
+    t = threading.Thread(target=lambda: out.extend(hb.batches()))
+    t.start()
+    hb.submit("lonely")
+    time.sleep(0.3)
+    # the lone block flushed on the timeout, not the 64-block fill
+    assert out == [["lonely"]]
+    hb.close()
+    t.join(5.0)

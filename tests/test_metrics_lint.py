@@ -39,6 +39,44 @@ def test_cache_group_registry_pinned():
     assert "rogue" in text                           # stray under prefix
 
 
+def test_ingest_registry_pinned():
+    """The juicefs_ingest_* series the bench and dedup drills
+    counter-assert must all exist; nothing squats under the prefix."""
+    lint = _load_lint()
+    assert lint.lint_ingest() == []
+    from juicefs_tpu.metric import Registry
+
+    reg = Registry()
+    reg.counter("juicefs_ingest_rogue", "unreviewed")
+    problems = lint.lint_ingest(registry=reg)
+    text = "\n".join(problems)
+    assert "juicefs_ingest_put_elided" in text  # missing expected
+    assert "rogue" in text                       # stray under prefix
+
+
+def test_ingest_seam_lint():
+    """WSlice uploads must route through the ingest stage when present:
+    the AST check passes on the real tree and bites on a bare upload."""
+    lint = _load_lint()
+    assert lint.lint_ingest_seam() == []
+    # a synthetic cached_store with an unconditional direct upload trips it
+    import tempfile
+
+    bad = (
+        "class WSlice:\n"
+        "    def _upload_block(self, indx, bsize):\n"
+        "        fut = self.store._pool.submit(self.store._put_or_stage, 1)\n"
+    )
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(bad)
+        path = f.name
+    try:
+        problems = lint.lint_ingest_seam(path)
+        assert problems and "_put_or_stage" in problems[0]
+    finally:
+        os.unlink(path)
+
+
 def test_lint_catches_bad_registrations():
     from juicefs_tpu.metric import Registry
 

@@ -29,6 +29,7 @@ def _populate_registry() -> None:
     import juicefs_tpu.cache.server         # noqa: F401  peer served counters
     import juicefs_tpu.chunk.cached_store   # noqa: F401  staging gauges
     import juicefs_tpu.chunk.disk_cache     # noqa: F401  disk tier counters
+    import juicefs_tpu.chunk.ingest         # noqa: F401  inline-dedup counters
     import juicefs_tpu.chunk.mem_cache      # noqa: F401  cache hit/miss/evict
     import juicefs_tpu.chunk.parallel       # noqa: F401  fetch_inflight gauge
     import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
@@ -104,6 +105,121 @@ def lint_cache_group(registry=None) -> list[str]:
     return problems
 
 
+# the ingest registry contract (ISSUE 5): same pinned-set pattern as the
+# cache group — the bench and the dedup drills counter-assert these series,
+# so a rename must fail CI instead of silently zeroing an elision dashboard
+INGEST_PREFIX = "juicefs_ingest_"
+INGEST_EXPECTED = {
+    "juicefs_ingest_blocks",
+    "juicefs_ingest_bytes",
+    "juicefs_ingest_put_elided",
+    "juicefs_ingest_put_elided_bytes",
+    "juicefs_ingest_uploaded",
+    "juicefs_ingest_passthrough",
+    "juicefs_ingest_race_collapsed",
+    "juicefs_ingest_errors",
+    "juicefs_ingest_queue_blocks",
+}
+
+
+def lint_ingest(registry=None) -> list[str]:
+    """Pin the juicefs_ingest_* registry: every expected series exists,
+    and no stray metric squats under the prefix unreviewed."""
+    from juicefs_tpu.metric import global_registry
+
+    if registry is None:
+        _populate_registry()
+    reg = registry or global_registry()
+    names = {m.name for m in reg.walk()}
+    problems = [
+        f"{name}: ingest metric missing from the registry"
+        for name in sorted(INGEST_EXPECTED - names)
+    ]
+    problems += [
+        f"{name}: unreviewed metric under {INGEST_PREFIX} (add it to "
+        "INGEST_EXPECTED in tools/lint_metrics.py)"
+        for name in sorted(n for n in names
+                           if n.startswith(INGEST_PREFIX)
+                           and n not in INGEST_EXPECTED)
+    ]
+    return problems
+
+
+def lint_ingest_seam(path: str | None = None) -> list[str]:
+    """No-bare-upload check (ISSUE 5): WSlice block uploads must flow
+    through the ingest stage when the store has one. Concretely: inside
+    `WSlice._upload_block`, every `_put_or_stage` submission must sit
+    under an `if` whose test references `ingest` — a refactor that
+    reintroduces an unconditional direct upload would silently disable
+    elision (writes still succeed, dedup just stops happening), which no
+    functional test catches on a low-dup workload."""
+    import ast
+
+    path = path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "juicefs_tpu", "chunk", "cached_store.py",
+    )
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    fn = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "WSlice":
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) \
+                        and item.name == "_upload_block":
+                    fn = item
+    if fn is None:
+        return ["WSlice._upload_block not found in chunk/cached_store.py"]
+
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    def guarded_by_ingest(node) -> bool:
+        cur = node
+        while id(cur) in parents:
+            cur = parents[id(cur)]
+            if isinstance(cur, ast.If) and any(
+                isinstance(n, (ast.Name, ast.Attribute))
+                and (getattr(n, "id", None) == "ingest"
+                     or getattr(n, "attr", None) == "ingest")
+                for n in ast.walk(cur.test)
+            ):
+                return True
+        return False
+
+    problems = []
+    bare = [
+        node for node in ast.walk(fn)
+        if isinstance(node, ast.Attribute) and node.attr == "_put_or_stage"
+        and not guarded_by_ingest(node)
+    ]
+    for node in bare:
+        problems.append(
+            f"chunk/cached_store.py:{node.lineno}: WSlice._upload_block "
+            "submits _put_or_stage outside an `ingest` guard — block "
+            "uploads must flow through the ingest stage when the store "
+            "has one"
+        )
+    # the guard must actually route somewhere: an ingest.submit call
+    has_submit = any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "submit"
+        and isinstance(node.func.value, (ast.Name, ast.Attribute))
+        and (getattr(node.func.value, "id", None) == "ingest"
+             or getattr(node.func.value, "attr", None) == "ingest")
+        for node in ast.walk(fn)
+    )
+    if not has_submit:
+        problems.append(
+            "chunk/cached_store.py: WSlice._upload_block never calls "
+            "ingest.submit(...) — the inline-dedup seam is gone"
+        )
+    return problems
+
+
 def lint_resilience(root: str | None = None) -> list[str]:
     """Sibling check (ISSUE 3): every `create_storage` consumer inside the
     package must reach the backend through the resilience wrapper — either
@@ -152,7 +268,8 @@ def lint_resilience(root: str | None = None) -> list[str]:
 
 
 def main() -> int:
-    problems = lint() + lint_cache_group() + lint_resilience()
+    problems = (lint() + lint_cache_group() + lint_ingest()
+                + lint_ingest_seam() + lint_resilience())
     if problems:
         for p in problems:
             print(f"lint_metrics: {p}", file=sys.stderr)

@@ -48,7 +48,11 @@ TEST_MAP = {
     "juicefs_tpu/vfs/reader": ["tests/test_vfs.py", "tests/test_fsx.py"],
     "juicefs_tpu/vfs/writer": ["tests/test_vfs.py", "tests/test_fsx.py"],
     "juicefs_tpu/chunk/cached_store": ["tests/test_chunk.py",
-                                       "tests/test_chaos.py"],
+                                       "tests/test_chaos.py",
+                                       "tests/test_ingest.py"],
+    "juicefs_tpu/chunk/ingest": ["tests/test_ingest.py"],
+    "juicefs_tpu/tpu/pipeline": ["tests/test_tpu_hash.py",
+                                 "tests/test_ingest.py"],
     "juicefs_tpu/chunk/disk_cache": ["tests/test_chunk.py"],
     "juicefs_tpu/object/resilient": ["tests/test_resilient.py",
                                      "tests/test_chaos.py"],
