@@ -261,17 +261,24 @@ class IngestPipeline:
         with self._lock:
             self._outstanding.discard(fut)
 
-    def _passthrough(self, key, raw, parent, fut: Future, count=True) -> Future:
+    def _passthrough(self, key, raw, parent, fut: Future, count=True,
+                     pool=None) -> Future:
         """Plain upload (no dedup): chain the caller-visible future onto
         an upload-pool task, preserving exception propagation. count=True
         (every dedup-degrade path: overload, racing close, meta-failure
         fallbacks) records the block as a passthrough; count=False is the
-        foreign-key path, which was never dedup-eligible."""
+        foreign-key path, which was never dedup-eligible.
+
+        `pool` defaults to the store's FOREGROUND upload pool (submit-time
+        degrades happen on the writer's own thread — they ARE the
+        foreground write); paths initiated from the ingest stage's daemon
+        threads pass `_ingest_pool` so fallback re-uploads classify as
+        INGEST per the class table (docs/ARCHITECTURE.md)."""
         if count:
             _PASSTHROUGH.inc()
             self.passthrough += 1
         try:
-            pool_fut = self.store._pool.submit(
+            pool_fut = (pool or self.store._pool).submit(
                 self.store._put_or_stage, key, raw, parent
             )
         except RuntimeError as e:  # pool shut down mid-teardown
@@ -300,7 +307,8 @@ class IngestPipeline:
                 logger.warning("ingest batch of %d degraded: %s", len(batch), e)
                 for key, raw, parent, fut, _p in batch:
                     if not fut.done():
-                        self._passthrough(key, raw, parent, fut)
+                        self._passthrough(key, raw, parent, fut,
+                                          pool=self.store._ingest_pool)
 
     def _process(self, batch: list) -> None:
         with _TR.span("chunk", "ingest", stage="hash", hist=_H_HASH) as sp:
@@ -350,7 +358,9 @@ class IngestPipeline:
         for digest, members in groups.items():
             leader = members[0]
             try:
-                pf = self.store._pool.submit(
+                # INGEST class (ISSUE 6): canonical PUTs rank below
+                # foreground reads/writes but above background bulk work
+                pf = self.store._ingest_pool.submit(
                     self.store._put_block, leader[0], leader[1], leader[2],
                     False,  # fingerprint=False: digest already recorded
                 )
@@ -463,7 +473,8 @@ class IngestPipeline:
         # pool-side upload chained to the member's future: the finalizer
         # thread must not serialize compress+PUT inline during a meta
         # brownout (the pool keeps follower fallbacks parallel)
-        self._passthrough(m[0], m[1], m[2], m[3])
+        self._passthrough(m[0], m[1], m[2], m[3],
+                          pool=self.store._ingest_pool)
 
     # -- lifecycle ---------------------------------------------------------
     def flush(self, timeout: float = 60.0) -> None:

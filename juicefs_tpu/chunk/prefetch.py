@@ -1,16 +1,23 @@
-"""Prefetcher: N workers warming upcoming blocks (reference: pkg/chunk/prefetch.go:21-66).
+"""Prefetcher: speculative block warming (reference: pkg/chunk/prefetch.go:21-66).
 
-Effectiveness accounting: every accepted fetch counts as *issued*; when a
-later cache hit consumes a block this prefetcher warmed (the store calls
-`consumed()` on its hit paths), it counts as *used*. issued-vs-used is the
-readahead efficiency signal (a low ratio means the window wastes GETs).
+Since ISSUE 6 the prefetcher owns no worker threads: fetches submit to the
+unified I/O scheduler at PREFETCH class (qos/scheduler.py), which ranks
+them below foreground reads — a readahead burst can no longer displace the
+read it was meant to accelerate — and SHEDS them on a full class queue
+(the cheap outcome of an overdriven window is a later cache miss, not
+backpressure on the read path).
+
+Effectiveness accounting is unchanged: every accepted fetch counts as
+*issued*; when a later cache hit consumes a block this prefetcher warmed
+(the store calls `consumed()` on its hit paths), it counts as *used*.
+issued-vs-used is the readahead efficiency signal (a low ratio means the
+window wastes GETs).
 """
 
 from __future__ import annotations
 
-import queue
 import threading
-from typing import Callable, Hashable
+from typing import Callable, Hashable, Optional
 
 from ..metric import global_registry
 from ..metric.trace import global_tracer, stage_hist
@@ -33,36 +40,56 @@ _H_FETCH = stage_hist("chunk", "prefetch", "fetch")
 
 _WARMED_CAP = 4096  # bounded issued-block memory for used-accounting
 
-_STOP = object()  # close() sentinel: one per worker, never a real key
-
 
 class Prefetcher:
-    def __init__(self, fetch: Callable[[Hashable], None], workers: int = 2, depth: int = 64):
+    def __init__(self, fetch: Callable[[Hashable], None], workers: int = 2,
+                 depth: int = 64, executor=None):
+        """`executor` is a PREFETCH-class ClassExecutor; without one the
+        process-global scheduler's download lane is used (widened to at
+        least `workers`).  `depth` bounds this prefetcher's outstanding
+        fetches on top of the scheduler's own PREFETCH queue bound.
+        `workers=0` disables readahead entirely (`ChunkConfig.prefetch`'s
+        off switch — concurrency above zero is scheduler-governed now,
+        but OFF must still mean zero speculative GETs)."""
+        self._enabled = workers != 0
+        if executor is None and self._enabled:
+            from ..qos import IOClass, global_scheduler
+
+            executor = global_scheduler().executor(
+                "download", IOClass.PREFETCH, width=max(2, workers))
+        self._ex = executor
         self._fetch = fetch
-        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._depth = max(1, depth)
         self._pending: set[Hashable] = set()
         self._warmed: dict[Hashable, None] = {}  # insertion-ordered FIFO
         self._lock = threading.Lock()
-        self._threads = [
-            threading.Thread(target=self._run, daemon=True, name=f"prefetch-{i}")
-            for i in range(workers)
-        ]
-        for t in self._threads:
-            t.start()
 
     def fetch(self, key: Hashable) -> None:
+        if not self._enabled:
+            return  # readahead off: not a shed, just no warming
         with self._lock:
             if key in self._pending:
                 _DUP.inc()
                 return
+            if len(self._pending) >= self._depth:
+                _DROPPED.inc()
+                return
             self._pending.add(key)
         try:
-            self._q.put_nowait(key)
-            _ISSUED.inc()
-        except queue.Full:
+            fut = self._ex.submit(self._run_one, key)
+        except (RuntimeError, TimeoutError):
+            # RuntimeError: racing close() — the owner no longer wants
+            # warming.  TimeoutError: scheduler backpressure leaked out of
+            # a demoted submit — speculative warming must never stall or
+            # fail the caller, and the key must leave _pending either way
+            fut = None
+        if fut is None:
+            # scheduler shed it (PREFETCH class queue full) or closed
             _DROPPED.inc()
             with self._lock:
                 self._pending.discard(key)
+        else:
+            _ISSUED.inc()
 
     def consumed(self, key: Hashable) -> None:
         """A cache hit consumed this block; count it as prefetch-used if
@@ -73,47 +100,36 @@ class Prefetcher:
             if self._warmed.pop(key, 0) is None:
                 _USED.inc()
 
-    def close(self) -> None:
-        """Stop the workers (one sentinel each; workers exit exactly once).
-        The queue is drained first so sentinels are next in line — close
-        means the owner no longer wants the cache warmed, and a backlog
-        against a slow backend must not stall teardown (workers only
-        finish the fetch they already started)."""
-        while True:
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
-        for _ in self._threads:
-            self._q.put(_STOP)
-        for t in self._threads:
-            t.join(timeout=5.0)
+    def close(self, timeout: Optional[float] = 10.0) -> None:
+        """Stop warming: queued fetches are cancelled, in-flight ones are
+        waited out (bounded) — close means the owner no longer wants the
+        cache warmed, and a backlog against a slow backend must not stall
+        teardown."""
+        if self._ex is not None:
+            self._ex.shutdown(wait=True, cancel_futures=True,
+                              timeout=timeout)
 
-    def _run(self) -> None:
-        while True:
-            key = self._q.get()
-            if key is _STOP:
-                return
-            try:
-                # (the store's fetch callable skips outright while its
-                # backend breaker is open — warming a dead backend would
-                # only queue EIO fast-fails; see CachedStore._prefetch_block)
-                with _TR.span("chunk", "prefetch", stage="fetch",
-                              hist=_H_FETCH) as sp:
-                    if sp.active:
-                        sp.set(key=str(key))
-                    did = self._fetch(key)
-                # only fetches that actually warmed something earn used-
-                # credit: a truthy return from the fetch callable; no-ops
-                # (already cached, object missing) must not inflate
-                # juicefs_prefetch_used
-                if did:
-                    with self._lock:
-                        self._warmed[key] = None
-                        while len(self._warmed) > _WARMED_CAP:
-                            self._warmed.pop(next(iter(self._warmed)))
-            except Exception:
-                pass
-            finally:
+    def _run_one(self, key: Hashable) -> None:
+        try:
+            # (the store's fetch callable skips outright while its
+            # backend breaker is open — warming a dead backend would
+            # only queue EIO fast-fails; see CachedStore._prefetch_block)
+            with _TR.span("chunk", "prefetch", stage="fetch",
+                          hist=_H_FETCH) as sp:
+                if sp.active:
+                    sp.set(key=str(key))
+                did = self._fetch(key)
+            # only fetches that actually warmed something earn used-
+            # credit: a truthy return from the fetch callable; no-ops
+            # (already cached, object missing) must not inflate
+            # juicefs_prefetch_used
+            if did:
                 with self._lock:
-                    self._pending.discard(key)
+                    self._warmed[key] = None
+                    while len(self._warmed) > _WARMED_CAP:
+                        self._warmed.pop(next(iter(self._warmed)))
+        except Exception:
+            pass
+        finally:
+            with self._lock:
+                self._pending.discard(key)

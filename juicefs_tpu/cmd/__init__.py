@@ -71,10 +71,17 @@ def chunk_conf(fmt: Format, args=None) -> ChunkConfig:
     )
     if getattr(args, "cache_size", None):
         conf.cache_size = int(args.cache_size) << 20
-    # bulk commands (gc --threads) govern the parallel-fetch window; the
-    # download pool must be at least that wide for the window to bite
-    if getattr(args, "threads", None):
-        conf.max_download = max(conf.max_download, int(args.threads))
+    # NOTE (ISSUE 6 satellite): `--threads` used to silently raise
+    # conf.max_download here, mutating the process-wide download pool.
+    # Command concurrency now routes through the unified scheduler's
+    # BACKGROUND class instead — build_store widens the download/bulk
+    # lanes to the command's width without touching foreground config.
+    # bandwidth shaping (qos/limiter.py): CLI limits are Mbps, the
+    # config carries bytes/s
+    if getattr(args, "upload_limit", None):
+        conf.upload_limit = float(args.upload_limit) * 1e6 / 8
+    if getattr(args, "download_limit", None):
+        conf.download_limit = float(args.download_limit) * 1e6 / 8
     # object-plane resilience knobs (object/resilient.py)
     if getattr(args, "op_deadline", None):
         conf.op_deadline = float(args.op_deadline)
@@ -102,6 +109,15 @@ def build_store(fmt: Format, args=None, meta=None,
     backend) for them would be pure startup cost."""
     conf = chunk_conf(fmt, args)
     store = CachedStore(storage_for(fmt), conf)
+    # bulk commands (gc/warmup --threads) run at BACKGROUND class; widen
+    # the shared lanes so the command's fetch window can actually go that
+    # deep — foreground config (max_download) is left untouched, and the
+    # scheduler's class priority keeps any concurrent foreground traffic
+    # ahead of the widened background stream (ISSUE 6 satellite)
+    threads = int(getattr(args, "threads", 0) or 0)
+    if threads > 0:
+        store.scheduler.widen("download", threads)
+        store.scheduler.widen("bulk", threads)
     if meta is not None:
         from ..chunk.indexer import pipeline_backend
         from ..chunk.ingest import ContentRefs, IngestPipeline

@@ -12,9 +12,9 @@ does not have (its gc diffs block *names* only, cmd/gc.go:253-296).
 from __future__ import annotations
 
 import json
-from concurrent.futures import ThreadPoolExecutor
 
 from ..chunk.cached_store import block_key, parse_block_key
+from ..qos import IOClass
 from ..utils import get_logger
 
 logger = get_logger("cmd.gc")
@@ -107,7 +107,11 @@ def run(args) -> int:
             logger.warning("missing block: %s", k)
 
     if leaked and args.delete:
-        with ThreadPoolExecutor(max_workers=args.threads) as pool:
+        # BACKGROUND class on the scheduler's bulk lane (ISSUE 6): a gc
+        # sweep sharing a process with a mount must not displace reads
+        with store.scheduler.executor(
+            "bulk", IOClass.BACKGROUND, width=args.threads
+        ) as pool:
             list(pool.map(store.storage.delete, leaked))
         print(f"deleted {len(leaked)} leaked objects")
 
@@ -180,7 +184,7 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
         yield from fetch_ordered(
             missing,
             lambda key: store._load_block(key, live[key], cache_after=False),
-            store._rpool, window, on_error="skip", stats=fstats,
+            store._bulk_pool, window, on_error="skip", stats=fstats,
         )
 
     t1 = _time.perf_counter()

@@ -37,6 +37,13 @@ def add_parser(sub):
     p.add_argument("--no-hedge", action="store_true",
                    help="disable hedged GETs (tail-latency duplicate "
                         "requests after the live p95)")
+    p.add_argument("--upload-limit", type=float, default=0,
+                   help="bandwidth limit for uploads in Mbps (0 = "
+                        "unlimited); charged at the object boundary, so "
+                        "retries and hedges count against it (ISSUE 6)")
+    p.add_argument("--download-limit", type=float, default=0,
+                   help="bandwidth limit for downloads in Mbps (0 = "
+                        "unlimited)")
     p.add_argument("--inline-dedup", action="store_true",
                    help="hash outgoing blocks (volume hash_backend, cpu "
                         "default) and skip compress+PUT for content the "

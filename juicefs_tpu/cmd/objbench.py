@@ -10,10 +10,10 @@ from __future__ import annotations
 import json
 import os
 import time
-from concurrent.futures import ThreadPoolExecutor
 
 from ..object import create_storage
 from ..object.interface import NotFoundError
+from ..qos import IOClass, global_scheduler
 
 
 def add_parser(sub):
@@ -148,7 +148,12 @@ def run(args) -> int:
             data = compressor.decompress(data, bs)
         return len(data)
 
-    with ThreadPoolExecutor(max_workers=args.threads) as pool:
+    # BACKGROUND class on the scheduler's bulk lane (ISSUE 6): the bench
+    # measures the shaped, scheduled object plane — the same path real
+    # bulk traffic takes
+    with global_scheduler().executor(
+        "bulk", IOClass.BACKGROUND, width=args.threads
+    ) as pool:
         t0 = time.perf_counter()
         list(pool.map(put_one, enumerate(keys)))
         if indexer is not None:
@@ -161,7 +166,9 @@ def run(args) -> int:
 
     small = os.urandom(128 << 10)
     skeys = [f"objbench/small/{i}" for i in range(args.small_objects)]
-    with ThreadPoolExecutor(max_workers=args.threads) as pool:
+    with global_scheduler().executor(
+        "bulk", IOClass.BACKGROUND, width=args.threads
+    ) as pool:
         t0 = time.perf_counter()
         list(pool.map(lambda k: store.put(k, small), skeys))
         sput_dt = time.perf_counter() - t0

@@ -24,10 +24,10 @@ import fnmatch
 import json
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 
 from ..object import create_storage
 from ..object.resilient import RetryPolicy, resilient
+from ..qos import IOClass, global_scheduler
 from ..utils import get_logger
 
 logger = get_logger("cmd.sync")
@@ -305,7 +305,11 @@ def run(args) -> int:
     stats = _new_stats()
     do = _make_executor(src, dst, args, stats)
     t0 = time.perf_counter()
-    with ThreadPoolExecutor(max_workers=args.threads) as pool:
+    # BACKGROUND class (ISSUE 6): bulk replication yields to any
+    # foreground traffic sharing the process and its bandwidth budget
+    with global_scheduler().executor(
+        "bulk", IOClass.BACKGROUND, width=args.threads
+    ) as pool:
         list(pool.map(do, tasks))
     stats["seconds"] = round(time.perf_counter() - t0, 3)
     print(json.dumps(stats))
@@ -563,7 +567,9 @@ def run_worker(args) -> int:
     pinger = threading.Thread(target=ping, daemon=True)
     pinger.start()
     try:
-        with ThreadPoolExecutor(max_workers=args.threads) as pool:
+        with global_scheduler().executor(
+            "bulk", IOClass.BACKGROUND, width=args.threads
+        ) as pool:
             while True:
                 out = post("/fetch", {"n": _BATCH})
                 tasks = [

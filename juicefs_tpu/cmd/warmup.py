@@ -33,8 +33,13 @@ def add_parser(sub):
 def fill_paths(m, store, paths: list[str], threads: int = 8,
                group=None) -> tuple[int, int]:
     """Warm every slice under the given paths; returns (files, slices).
-    With `group` (a cache.CacheGroup) only ring-owned blocks are fetched."""
-    from concurrent.futures import ThreadPoolExecutor
+    With `group` (a cache.CacheGroup) only ring-owned blocks are fetched.
+
+    Per-slice fills fan out at BACKGROUND class on the scheduler's bulk
+    lane (ISSUE 6): warmup is maintenance, and its block loads (nested on
+    the download lane) inherit background priority via the ambient-class
+    demotion rule — a concurrent foreground reader keeps its p99."""
+    from ..qos import IOClass
 
     files = []
 
@@ -73,7 +78,9 @@ def fill_paths(m, store, paths: list[str], threads: int = 8,
             tasks.extend((s.id, s.size) for s in slices if s.id)
 
     only = group.owns if group is not None else None
-    with ThreadPoolExecutor(max_workers=threads) as pool:
+    with store.scheduler.executor(
+        "bulk", IOClass.BACKGROUND, width=threads
+    ) as pool:
         list(pool.map(lambda t: store.fill_cache(*t, only=only), tasks))
     return len(files), len(tasks)
 

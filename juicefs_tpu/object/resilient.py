@@ -47,6 +47,7 @@ from typing import Callable, Optional
 
 from ..metric import global_registry
 from ..metric.trace import global_tracer
+from ..qos import context as _qctx
 from ..utils import get_logger
 from .interface import NotFoundError, ObjectStorage, PermanentError, ThrottleError
 
@@ -681,16 +682,23 @@ class ResilientStorage(ObjectStorage):
 
     def _submit(self, fn: Callable[[], object]) -> Future:
         # span context must survive the pool crossing: the metered wrapper
-        # below us opens object-layer spans from the worker thread
+        # below us opens object-layer spans from the worker thread.  The
+        # ambient QoS context crosses too, so a retry or hedged duplicate
+        # is charged to the same tenant/class bandwidth budget as the op
+        # that spawned it (qos/limiter.py sub-bucket attribution).
         ref = _TR.current_ref()
-        if ref is None:
+        qos = _qctx.current()
+        if ref is None and qos is None:
             return self._pool.submit(fn)
-        return self._pool.submit(lambda: self._carried(ref, fn))
+        return self._pool.submit(lambda: self._carried(ref, qos, fn))
 
     @staticmethod
-    def _carried(ref, fn):
-        with _TR.carried(ref):
-            return fn()
+    def _carried(ref, qos, fn):
+        with _qctx.applied(qos):
+            if ref is None:
+                return fn()
+            with _TR.carried(ref):
+                return fn()
 
     def _bounded(self, method: str, fn: Callable[[], object], timeout: float):
         fut = self._submit(fn)

@@ -10,20 +10,21 @@ meta swap keeps any slices appended after the snapshot.
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
-
 from ..chunk.parallel import fetch_ordered
 from ..meta.slice import build_slice
 from ..meta.types import Slice
+from ..qos import IOClass, scoped
 from ..utils import get_logger
 
 logger = get_logger("vfs.compact")
 
 MIN_SLICES_TO_COMPACT = 2
-# segment-read fan-out per compaction; a transient pool, NOT the store's
-# download pool: RSlice.read submits block loads there and waits, and a
-# bounded pool waiting on itself deadlocks (docs/ARCHITECTURE.md
-# "Concurrency model")
+# segment-read fan-out per compaction on the scheduler's "bulk" lane,
+# NOT the store's download lane: RSlice.read submits block loads there
+# and waits, and a bounded worker set waiting on itself deadlocks
+# (docs/ARCHITECTURE.md "Concurrency model").  BACKGROUND class: the
+# ambient-class demotion rule then keeps the nested block loads and the
+# rewrite uploads at background priority too.
 COMPACT_READ_WINDOW = 4
 
 
@@ -53,12 +54,14 @@ def compact_chunk(meta, store, ino: int, indx: int) -> bool:
         # overlap the old slices' reads; in-order yield keeps the writer
         # sequential.  A failed read is corruption here, so it raises and
         # aborts the rewrite (error policy opposite of the gc scan's).
-        with ThreadPoolExecutor(
-            max_workers=window, thread_name_prefix="compact-read"
+        # scoped(BACKGROUND) demotes the nested block loads AND the
+        # rewrite's uploads, which are submitted from this thread.
+        with scoped(cls=IOClass.BACKGROUND), store.scheduler.executor(
+            "bulk", IOClass.BACKGROUND, width=window
         ) as pool:
             for seg, data in fetch_ordered(view, read_seg, pool, window):
                 ws.write_at(data, seg.pos)
-        ws.finish(length)
+            ws.finish(length)
     except Exception as e:
         logger.warning("compact ino=%d indx=%d: rewrite failed: %s", ino, indx, e)
         ws.abort()

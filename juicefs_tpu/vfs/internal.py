@@ -238,6 +238,14 @@ class InternalFiles:
             # ring membership + per-peer breaker state (ISSUE 4: a dead
             # peer's open breaker must be observable here)
             out["cache_group"] = group.health()
+        # unified I/O scheduler + bandwidth budget (ISSUE 6): lane/queue
+        # occupancy per class and token-bucket levels
+        sched = getattr(store, "scheduler", None)
+        if sched is not None:
+            out["qos"] = sched.snapshot()
+        limiter = getattr(store, "limiter", None)
+        if limiter is not None:
+            out.setdefault("qos", {})["limiter"] = limiter.snapshot()
         return out
 
     def read(self, ino: int, fh: int, off: int, size: int) -> tuple[int, bytes]:

@@ -19,7 +19,6 @@ prefetch worker pool:
 from __future__ import annotations
 
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from ..chunk import CachedStore
@@ -183,11 +182,14 @@ class DataReader:
         self.store = store
         self.max_readahead = max_readahead
         self._writer = writer
-        # slice-level fan-out for fragmented chunks; separate from the
-        # store's block-level pool so nested submits cannot deadlock
-        self.spool = ThreadPoolExecutor(
-            max_workers=store.conf.max_download, thread_name_prefix="slice-read"
-        )
+        # slice-level fan-out for fragmented chunks on the unified
+        # scheduler's "slice" lane — a separate lane from the store's
+        # block-level "download" lane so nested submits cannot deadlock
+        # (ISSUE 6; docs/ARCHITECTURE.md "Concurrency model")
+        from ..qos import IOClass
+
+        self.spool = store.scheduler.executor(
+            "slice", IOClass.FOREGROUND, width=store.conf.max_download)
 
     def open(self, ino: int) -> FileReader:
         return FileReader(self, ino)

@@ -34,6 +34,7 @@ from ..meta.types import (
     TYPE_FILE,
 )
 from ..metric import global_registry
+from ..qos import tenant_scope
 from ..utils import get_logger
 from .accesslog import AccessLogger
 from .cache import MetaCache
@@ -469,7 +470,11 @@ class VFS:
                 return st, b""
         h.begin_read()
         try:
-            return h.reader.read(ctx, off, size)
+            # per-tenant fair queueing (ISSUE 6): block I/O this read fans
+            # out is DRR-queued under the requesting uid, so one user
+            # flooding reads cannot monopolize the foreground class
+            with tenant_scope(ctx.uid):
+                return h.reader.read(ctx, off, size)
         finally:
             h.end_read()
 
@@ -485,16 +490,20 @@ class VFS:
             return _errno.EFBIG
         h.begin_write()
         try:
-            # Kernel-writeback mode: the kernel positions O_APPEND writes
-            # itself and flushes whole cached pages at explicit offsets —
-            # re-deriving EOF here would double-place the data.
-            if h.flags & os.O_APPEND and not self.always_readable_handles:
-                with self._append_lock:
-                    st, attr = self.getattr(ctx, ino)
-                    if st != 0:
-                        return st
-                    return h.writer.write(attr.length, data)
-            return h.writer.write(off, data)
+            # uploads triggered by this write are queued under the
+            # requesting uid (per-tenant fair queueing, ISSUE 6)
+            with tenant_scope(ctx.uid):
+                # Kernel-writeback mode: the kernel positions O_APPEND
+                # writes itself and flushes whole cached pages at explicit
+                # offsets — re-deriving EOF here would double-place the
+                # data.
+                if h.flags & os.O_APPEND and not self.always_readable_handles:
+                    with self._append_lock:
+                        st, attr = self.getattr(ctx, ino)
+                        if st != 0:
+                            return st
+                        return h.writer.write(attr.length, data)
+                return h.writer.write(off, data)
         finally:
             h.end_write()
 

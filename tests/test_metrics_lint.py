@@ -77,6 +77,51 @@ def test_ingest_seam_lint():
         os.unlink(path)
 
 
+def test_qos_registry_pinned():
+    """The juicefs_qos_* series the chaos drill and BENCH_r07 counter-
+    assert must all exist; nothing squats under the prefix."""
+    lint = _load_lint()
+    assert lint.lint_qos() == []
+    from juicefs_tpu.metric import Registry
+
+    reg = Registry()
+    reg.counter("juicefs_qos_rogue", "unreviewed")
+    problems = lint.lint_qos(registry=reg)
+    text = "\n".join(problems)
+    assert "juicefs_qos_submitted" in text  # missing expected
+    assert "rogue" in text                   # stray under prefix
+
+
+def test_qos_seam_lint():
+    """No bare ThreadPoolExecutor outside qos/ and the whitelisted
+    resilience pool: passes on the real tree, bites on a synthetic
+    module that spins up its own pool."""
+    import tempfile
+
+    lint = _load_lint()
+    assert lint.lint_qos_seam() == []
+    with tempfile.TemporaryDirectory() as root:
+        bad = os.path.join(root, "rogue.py")
+        with open(bad, "w") as f:
+            f.write(
+                "from concurrent.futures import ThreadPoolExecutor\n"
+                "def go():\n"
+                "    with ThreadPoolExecutor(max_workers=4) as p:\n"
+                "        pass\n"
+            )
+        # a commented/docstring mention must NOT trip it
+        ok = os.path.join(root, "fine.py")
+        with open(ok, "w") as f:
+            f.write('"""mentions ThreadPoolExecutor only in prose"""\n')
+        problems = lint.lint_qos_seam(root)
+        assert len(problems) == 1 and "rogue.py:3" in problems[0]
+        # the whitelisted resilience pool path is exempt
+        objdir = os.path.join(root, "object")
+        os.makedirs(objdir)
+        os.rename(bad, os.path.join(objdir, "resilient.py"))
+        assert lint.lint_qos_seam(root) == []
+
+
 def test_lint_catches_bad_registrations():
     from juicefs_tpu.metric import Registry
 

@@ -264,6 +264,9 @@ def test_fill_cache_parallel_and_raises():
 
 
 def test_prefetcher_close_stops_workers():
+    """Since ISSUE 6 the prefetcher owns no threads — it submits to the
+    unified scheduler at PREFETCH class.  close() drains its own work and
+    refuses new fetches; the shared scheduler workers keep running."""
     from juicefs_tpu.chunk.prefetch import Prefetcher
 
     fetched = []
@@ -274,7 +277,16 @@ def test_prefetcher_close_stops_workers():
         time.sleep(0.01)
     assert fetched == [("k", 1)]
     p.close()
-    assert all(not t.is_alive() for t in p._threads)
+    # a fetch after close is dropped, never submitted
+    p.fetch(("k2", 1))
+    time.sleep(0.05)
+    assert fetched == [("k", 1)]
+    # the scheduler the prefetcher rode is still alive for other users
+    from juicefs_tpu.qos import IOClass, global_scheduler
+
+    ex = global_scheduler().executor("download", IOClass.FOREGROUND)
+    assert ex.submit(lambda: 7).result(timeout=5) == 7
+    ex.shutdown()
 
 
 def test_pipeline_inflight_depth_preserves_results():

@@ -1,0 +1,788 @@
+"""Unified I/O scheduler + bandwidth shaping (ISSUE 6).
+
+Covers the scheduler contracts (strict priority, DRR tenant fairness,
+starvation floor, foreground reserve, shedding, backpressure, executor
+shutdown isolation, class demotion, tenant inheritance), the token-bucket
+accuracy contract, hierarchical per-class sub-buckets charged through the
+resilience layer's elastic pool, and the chaos-style drill: a saturating
+BACKGROUND scan under a FOREGROUND read stream.
+"""
+
+import threading
+import time
+
+import pytest
+
+from juicefs_tpu.chunk.cached_store import CachedStore, ChunkConfig, block_key
+from juicefs_tpu.object.mem import MemStorage
+from juicefs_tpu.qos import (
+    IOClass,
+    Limiter,
+    QosContext,
+    Scheduler,
+    TokenBucket,
+    gated,
+    global_scheduler,
+    shaped,
+    tenant_scope,
+)
+from juicefs_tpu.qos import context as qctx
+from juicefs_tpu.metric import global_registry
+
+_REG = global_registry()
+
+
+def _counter(name, *labels):
+    m = _REG._metrics[name]
+    return m.labels(*labels) if labels else m
+
+
+# -- scheduler core --------------------------------------------------------
+
+def test_priority_foreground_before_background():
+    s = Scheduler(floor_every=0)
+    try:
+        gate = threading.Event()
+        order = []
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)  # occupy the worker
+        time.sleep(0.05)
+        bg = [s.submit("x", IOClass.BACKGROUND,
+                       lambda i=i: order.append(("bg", i))) for i in range(3)]
+        fg = [s.submit("x", IOClass.FOREGROUND,
+                       lambda i=i: order.append(("fg", i))) for i in range(3)]
+        gate.set()
+        for f in bg + fg:
+            f.result(5)
+        assert order[:3] == [("fg", 0), ("fg", 1), ("fg", 2)]
+        assert sorted(order[3:]) == [("bg", 0), ("bg", 1), ("bg", 2)]
+    finally:
+        s.close()
+
+
+def test_mid_tier_between_foreground_and_background():
+    s = Scheduler(floor_every=0)
+    try:
+        gate = threading.Event()
+        order = []
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)
+        time.sleep(0.05)
+        s.submit("x", IOClass.BACKGROUND, lambda: order.append("bg"))
+        s.submit("x", IOClass.INGEST, lambda: order.append("in"))
+        f = s.submit("x", IOClass.FOREGROUND, lambda: order.append("fg"))
+        gate.set()
+        f.result(5)
+        deadline = time.time() + 5
+        while len(order) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert order == ["fg", "in", "bg"]
+    finally:
+        s.close()
+
+
+def test_drr_fairness_across_tenants():
+    """One tenant flooding a class cannot monopolize it: with equal
+    weights completions interleave; with weight 3 vs 1 the heavy tenant
+    gets ~3x the early slots."""
+    s = Scheduler(floor_every=0)
+    try:
+        gate = threading.Event()
+        order = []
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)
+        time.sleep(0.05)
+        futs = []
+        for i in range(20):  # tenant A floods first
+            futs.append(s.submit("x", IOClass.FOREGROUND,
+                                 lambda i=i: order.append("a"), tenant="a"))
+        for i in range(20):
+            futs.append(s.submit("x", IOClass.FOREGROUND,
+                                 lambda i=i: order.append("b"), tenant="b"))
+        gate.set()
+        for f in futs:
+            f.result(5)
+        # despite A's 20-deep head start, B appears early and often
+        first = order[:10]
+        assert first.count("b") >= 3, order
+        assert first.count("a") >= 3, order
+    finally:
+        s.close()
+
+
+def test_drr_weight_skews_share():
+    s = Scheduler(floor_every=0)
+    try:
+        gate = threading.Event()
+        order = []
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)
+        time.sleep(0.05)
+        futs = []
+        for i in range(24):
+            futs.append(s.submit("x", IOClass.FOREGROUND,
+                                 lambda: order.append("heavy"),
+                                 tenant="heavy", weight=3))
+            futs.append(s.submit("x", IOClass.FOREGROUND,
+                                 lambda: order.append("light"),
+                                 tenant="light", weight=1))
+        gate.set()
+        for f in futs:
+            f.result(5)
+        first = order[:16]
+        assert first.count("heavy") > first.count("light"), order
+    finally:
+        s.close()
+
+
+def test_background_floor_prevents_starvation():
+    """Under a continuous FOREGROUND backlog, the floor dispatch still
+    serves BACKGROUND: the first background task completes long before
+    the foreground queue drains."""
+    s = Scheduler(floor_every=4)
+    try:
+        gate = threading.Event()
+        order = []
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)
+        time.sleep(0.05)
+        futs = [s.submit("x", IOClass.FOREGROUND,
+                         lambda i=i: order.append(("fg", i)))
+                for i in range(30)]
+        futs += [s.submit("x", IOClass.BACKGROUND,
+                          lambda i=i: order.append(("bg", i)))
+                 for i in range(3)]
+        gate.set()
+        for f in futs:
+            f.result(5)
+        first_bg = next(i for i, (k, _) in enumerate(order) if k == "bg")
+        assert first_bg < 20, order
+    finally:
+        s.close()
+
+
+def test_foreground_reserve_caps_background_inflight():
+    """On a lane serving foreground traffic, a width-2 lane with the
+    default reserve of 1 never runs more than one BACKGROUND task at
+    once — the other worker stays free for foreground arrivals."""
+    s = Scheduler()
+    try:
+        s.lane("x", 2)
+        # arm the reserve: the lane has seen foreground work
+        s.submit("x", IOClass.FOREGROUND, lambda: None).result(5)
+        release = threading.Event()
+        started = []
+
+        def bg(i):
+            started.append(i)
+            release.wait(5)
+
+        futs = [s.submit("x", IOClass.BACKGROUND, bg, i) for i in range(4)]
+        time.sleep(0.15)
+        assert len(started) == 1, started
+        # a foreground task cuts straight through on the reserved worker
+        assert s.submit("x", IOClass.FOREGROUND,
+                        lambda: 42).result(timeout=5) == 42
+        release.set()
+        for f in futs:
+            f.result(5)
+        assert sorted(started) == [0, 1, 2, 3]
+    finally:
+        s.close()
+
+
+def test_reserve_unarmed_gives_bulk_commands_full_width():
+    """A lane that has NEVER seen foreground work (a dedicated gc/warmup/
+    sync process) runs BACKGROUND at full width — the reserve only arms
+    while there is foreground traffic to protect (ISSUE 6 review: the
+    reserve must not shave a bulk command's fetch window)."""
+    s = Scheduler()
+    try:
+        s.lane("x", 4)
+        release = threading.Event()
+        running = []
+
+        def bg(i):
+            running.append(i)
+            release.wait(5)
+
+        futs = [s.submit("x", IOClass.BACKGROUND, bg, i) for i in range(4)]
+        deadline = time.time() + 5
+        while len(running) < 4 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(running) == 4, running  # no idle reserved worker
+        release.set()
+        for f in futs:
+            f.result(5)
+    finally:
+        s.close()
+
+
+def test_default_floor_keeps_strict_priority_dominant():
+    """With the DEFAULT floor_every the floor is the exception, not the
+    rule: under a mixed backlog the early completions are dominated by
+    foreground (mutation survivor: flipping the floor modulo check made
+    7-of-8 dispatches inverted and nothing failed)."""
+    s = Scheduler()  # default floor_every=8
+    try:
+        gate = threading.Event()
+        order = []
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)
+        time.sleep(0.05)
+        futs = [s.submit("x", IOClass.BACKGROUND,
+                         lambda: order.append("bg")) for _ in range(8)]
+        futs += [s.submit("x", IOClass.FOREGROUND,
+                          lambda: order.append("fg")) for _ in range(8)]
+        gate.set()
+        for f in futs:
+            f.result(5)
+        assert order[:6].count("fg") >= 5, order
+    finally:
+        s.close()
+
+
+def test_reserve_counts_prefetch_and_background_together():
+    """PREFETCH and BACKGROUND share the speculative budget: on an armed
+    width-2 lane with reserve 1, a running prefetch blocks a background
+    dispatch (they must not each get their own reserve accounting)."""
+    s = Scheduler()
+    try:
+        s.lane("x", 2)
+        s.submit("x", IOClass.FOREGROUND, lambda: None).result(5)  # arm
+        release = threading.Event()
+        started = []
+
+        def spec(tag):
+            started.append(tag)
+            release.wait(5)
+
+        s.submit("x", IOClass.PREFETCH, spec, "pf")
+        deadline = time.time() + 5
+        while "pf" not in started and time.time() < deadline:
+            time.sleep(0.01)
+        bg = s.submit("x", IOClass.BACKGROUND, spec, "bg")
+        time.sleep(0.15)
+        assert started == ["pf"], started  # bg held behind the reserve
+        release.set()
+        bg.result(5)
+    finally:
+        s.close()
+
+
+def test_wait_histogram_measures_queue_wait():
+    """juicefs_qos_wait_seconds records submit-to-dispatch wait, not a
+    clock artifact: one uncontended task adds ~zero to the sum."""
+    h = _REG._metrics["juicefs_qos_wait_seconds"].labels("foreground")
+    before_sum, before_total = h.sum, h.total
+    s = Scheduler()
+    try:
+        s.submit("w", IOClass.FOREGROUND, lambda: None).result(5)
+    finally:
+        s.close()
+    assert h.total > before_total
+    assert h.sum - before_sum < 60.0
+
+
+def test_backpressure_timeout_raises():
+    """A bounded non-sheddable class gives up with TimeoutError after
+    bound_wait instead of blocking the producer forever."""
+    s = Scheduler(bounds={IOClass.BACKGROUND: 1}, bound_wait=0.05)
+    try:
+        gate = threading.Event()
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)
+        time.sleep(0.05)
+        s.submit("x", IOClass.BACKGROUND, lambda: None)  # fills the bound
+        err = []
+
+        def produce():
+            try:
+                s.submit("x", IOClass.BACKGROUND, lambda: None)
+            except TimeoutError as e:
+                err.append(e)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        t.join(3)
+        assert not t.is_alive(), "backpressured submit never timed out"
+        assert err, "expected TimeoutError from the bounded submit"
+        gate.set()
+    finally:
+        s.close()
+
+
+def test_prefetch_sheds_on_full_queue():
+    s = Scheduler(bounds={IOClass.PREFETCH: 2})
+    try:
+        gate = threading.Event()
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)
+        time.sleep(0.05)
+        shed0 = _counter("juicefs_qos_shed", "prefetch").value
+        res = [s.submit("x", IOClass.PREFETCH, lambda: None)
+               for _ in range(6)]
+        dropped = sum(1 for r in res if r is None)
+        assert dropped == 4
+        assert _counter("juicefs_qos_shed", "prefetch").value == shed0 + 4
+        gate.set()
+        for r in res:
+            if r is not None:
+                r.result(5)
+    finally:
+        s.close()
+
+
+def test_background_backpressure_blocks_producer():
+    s = Scheduler(bounds={IOClass.BACKGROUND: 2})
+    try:
+        gate = threading.Event()
+        s.submit("x", IOClass.FOREGROUND, gate.wait, 5)
+        time.sleep(0.05)
+        for _ in range(2):
+            s.submit("x", IOClass.BACKGROUND, lambda: None)
+        submitted = threading.Event()
+
+        def produce():
+            s.submit("x", IOClass.BACKGROUND, lambda: None)
+            submitted.set()
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        assert not submitted.is_set()  # producer is backpressured
+        gate.set()
+        assert submitted.wait(5)
+        t.join(5)
+    finally:
+        s.close()
+
+
+def test_executor_shutdown_is_isolated():
+    """ClassExecutor.shutdown drains only its own submissions; another
+    executor on the same scheduler keeps working (the store-close
+    contract, ISSUE 6 satellite)."""
+    s = Scheduler()
+    try:
+        ex1 = s.executor("x", IOClass.FOREGROUND, width=2)
+        ex2 = s.executor("x", IOClass.FOREGROUND)
+        fs = [ex1.submit(lambda i=i: i) for i in range(5)]
+        ex1.shutdown(wait=True)
+        assert [f.result(0) for f in fs] == list(range(5))
+        with pytest.raises(RuntimeError):
+            ex1.submit(lambda: None)
+        assert ex2.submit(lambda: "alive").result(timeout=5) == "alive"
+    finally:
+        s.close()
+
+
+def test_executor_shutdown_waits_for_racing_submit():
+    """A submit that passed the closed-check when shutdown(wait=True)
+    lands must still be in the drain: the raced future may not escape
+    the wait set (the store-close contract would otherwise race the
+    breaker-recovery thread's replay submits)."""
+    s = Scheduler()
+    try:
+        ex = s.executor("race", IOClass.FOREGROUND, width=1)
+        entered, release = threading.Event(), threading.Event()
+        real_submit = s.submit
+
+        def stalled_submit(*a, **kw):
+            entered.set()
+            release.wait(5)  # hold the submit mid-flight
+            return real_submit(*a, **kw)
+
+        s.submit = stalled_submit
+        ran = threading.Event()
+        t = threading.Thread(target=lambda: ex.submit(ran.set))
+        t.start()
+        assert entered.wait(5)
+        s.submit = real_submit  # only the in-flight call stays stalled
+        drained = threading.Event()
+        st = threading.Thread(
+            target=lambda: (ex.shutdown(wait=True), drained.set()))
+        st.start()
+        time.sleep(0.1)
+        assert not drained.is_set()  # shutdown waits out the raced submit
+        release.set()
+        t.join(5)
+        st.join(5)
+        assert drained.is_set()
+        assert ran.wait(5)  # the raced task was drained, not dropped
+    finally:
+        s.close()
+
+
+def test_gate_wait_runs_outside_resilience_timers():
+    """The token gate sits ABOVE the resilience layer: a saturated
+    bandwidth cap delays the op but never counts against the attempt
+    deadline (and so never feeds hedges or the breaker) — a self-imposed
+    cap must not masquerade as a failing backend."""
+    from juicefs_tpu.object.resilient import RetryPolicy, resilient
+
+    lim = Limiter(download_bps=1000.0, burst=16)
+    lim.charge(Limiter.DOWNLOAD, 400)  # ~0.4s of debt at 1 kB/s
+    inner = MemStorage("gateout")
+    inner.put("k", b"z" * 16)
+    rs = gated(resilient(shaped(inner, lim),
+                         policy=RetryPolicy(deadline=5, max_attempts=1,
+                                            attempt_timeout=0.1)), lim)
+    try:
+        t0 = time.monotonic()
+        data = rs.get("k")  # with the gate inside the attempt this would
+        waited = time.monotonic() - t0   # abandon at attempt_timeout
+        assert data == b"z" * 16
+        assert waited > 0.25
+    finally:
+        rs.close()
+
+
+def test_prefetch_zero_disables_readahead():
+    """ChunkConfig.prefetch=0 must still be the readahead off switch
+    under the shared scheduler: zero speculative submits, not
+    full-lane-width warming."""
+    from juicefs_tpu.chunk.prefetch import Prefetcher
+
+    fetched = []
+    s = Scheduler()
+    try:
+        p = Prefetcher(lambda k: fetched.append(k) or True, workers=0,
+                       executor=s.executor("pf", IOClass.PREFETCH))
+        for i in range(8):
+            p.fetch(i)
+        time.sleep(0.2)
+        assert fetched == []
+        p.close()
+    finally:
+        s.close()
+
+
+def test_class_demotion_and_tenant_inheritance():
+    """A nested submit from a BACKGROUND task is demoted even through a
+    FOREGROUND executor; tenant_scope tags submits from plain threads."""
+    s = Scheduler()
+    try:
+        fg_ex = s.executor("inner", IOClass.FOREGROUND)
+        seen = {}
+
+        def inner():
+            ctx = qctx.current()
+            seen["cls"] = ctx.cls
+            seen["tenant"] = ctx.tenant
+
+        def outer():
+            fg_ex.submit(inner).result(5)
+
+        s.submit("outer", IOClass.BACKGROUND, outer,
+                 tenant="alice").result(5)
+        assert seen["cls"] is IOClass.BACKGROUND
+        assert seen["tenant"] == "alice"
+
+        with tenant_scope(1042):
+            fg_ex.submit(inner).result(5)
+        assert seen["cls"] is IOClass.FOREGROUND
+        assert seen["tenant"] == 1042
+    finally:
+        s.close()
+
+
+def test_scheduler_snapshot_shape():
+    s = Scheduler()
+    try:
+        ex = s.executor("snaplane", IOClass.FOREGROUND, width=3)
+        ex.submit(lambda: None).result(5)
+        snap = s.snapshot()
+        assert snap["lanes"]["snaplane"]["width"] == 3
+        assert "foreground" in snap["classes"]
+        assert snap["classes"]["foreground"]["submitted"] >= 1
+    finally:
+        s.close()
+
+
+def test_fetch_ordered_rides_class_executor():
+    from juicefs_tpu.chunk.parallel import fetch_ordered
+
+    s = Scheduler()
+    try:
+        ex = s.executor("fo", IOClass.BACKGROUND, width=4)
+        out = list(fetch_ordered(range(20), lambda i: i * i, ex, 4))
+        assert out == [(i, i * i) for i in range(20)]
+    finally:
+        s.close()
+
+
+# -- token bucket / limiter ------------------------------------------------
+
+def test_token_bucket_accuracy_within_ten_percent():
+    """Sustained acquire() throughput lands within +-10% of the
+    configured rate over a 2s window (ISSUE 6 acceptance)."""
+    rate = 20e6  # 20 MB/s
+    tb = TokenBucket(rate, burst=256 << 10)
+    chunk = 256 << 10
+    n = 0
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 2.0:
+        tb.acquire(chunk)
+        n += chunk
+    measured = n / (time.monotonic() - t0)
+    assert abs(measured - rate) / rate < 0.10, f"{measured/1e6:.1f} MB/s"
+
+
+def test_token_bucket_construction_contract():
+    """Mutation survivors (BENCHMARKS §6d): the default burst is
+    max(rate/8, 1 MiB), a non-positive rate is rejected, and a
+    satisfied gate reports ~zero wait."""
+    assert TokenBucket(1e6).burst == 1 << 20          # floor wins
+    assert TokenBucket(80e6).burst == pytest.approx(10e6)  # rate/8 wins
+    with pytest.raises(ValueError):
+        TokenBucket(0)
+    with pytest.raises(ValueError):
+        TokenBucket(-5)
+    tb = TokenBucket(1e6)
+    assert tb.gate() < 0.5  # tokens available: no wait reported
+
+
+def test_token_bucket_gate_timeout():
+    """A gate whose projected token wait exceeds its timeout raises
+    TimeoutError promptly instead of sleeping out the debt."""
+    tb = TokenBucket(100.0, burst=10)
+    tb.charge(60)  # ~0.5s of debt at 100 B/s
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        tb.gate(timeout=0.05)
+    assert time.monotonic() - t0 < 0.4  # raised early, not slept out
+
+
+def test_limiter_rejects_nonpositive_rates_quietly():
+    """A zero or negative CLI limit means 'unshaped', never a bucket."""
+    lim = Limiter(upload_bps=0.0, download_bps=-1.0)
+    assert not lim.enabled(Limiter.UPLOAD)
+    assert not lim.enabled(Limiter.DOWNLOAD)
+    assert lim.gate(Limiter.DOWNLOAD) == 0.0  # no-op, no wait
+
+
+def test_limiter_unthrottled_charge_counts_no_throttled_bytes():
+    """juicefs_qos_throttled_bytes only counts bytes that actually
+    waited for tokens — an unthrottled charge must not inflate it."""
+    lim = Limiter(download_bps=1e9, burst=1e9)
+    before = _counter("juicefs_qos_throttled_bytes", "download").value
+    lim.charge(Limiter.DOWNLOAD, 4096, waited=0.0)
+    assert _counter("juicefs_qos_throttled_bytes",
+                    "download").value == before
+
+
+def test_token_bucket_debt_model():
+    tb = TokenBucket(1e6, burst=1024)
+    tb.acquire(1 << 20)  # oversized burst admitted once...
+    t0 = time.monotonic()
+    tb.acquire(1)        # ...then paid back before the next op
+    assert time.monotonic() - t0 > 0.5
+
+
+def test_limiter_class_subbucket_charges_through_context():
+    # refill rate of 2 B/s: charges stay visible in level_bytes without
+    # refill drift racing the assertions
+    lim = Limiter(upload_bps=2.0, class_caps={"background": 0.5},
+                  burst=1e9)
+    with qctx.applied(QosContext(0, 1, IOClass.BACKGROUND)):
+        lim.acquire(Limiter.UPLOAD, 1000)
+    snap = lim.snapshot()
+    sub = snap["class_caps"]["upload/background"]
+    assert sub["rate_bps"] == pytest.approx(1.0)
+    assert sub["level_bytes"] <= 1e9 - 900  # charged
+    assert snap["upload"]["level_bytes"] <= 1e9 - 900  # global too
+    # foreground traffic only charges the global bucket
+    with qctx.applied(QosContext(0, 1, IOClass.FOREGROUND)):
+        lim.acquire(Limiter.UPLOAD, 1000)
+    snap2 = lim.snapshot()
+    assert snap2["class_caps"]["upload/background"]["level_bytes"] == \
+        pytest.approx(sub["level_bytes"], abs=10)
+
+
+def test_shaped_put_charges_every_resilient_attempt():
+    """Retries count against the bandwidth budget: a PUT that fails once
+    charges the bucket twice (shaped sits BELOW the resilience layer),
+    and the QoS context crosses the elastic pool so per-class sub-buckets
+    attribute correctly."""
+    from juicefs_tpu.object.resilient import RetryPolicy, resilient
+
+    class FailOnce(MemStorage):
+        def __init__(self):
+            super().__init__("failonce")
+            self.calls = 0
+
+        def put(self, key, data):
+            self.calls += 1
+            if self.calls == 1:
+                raise IOError("transient")
+            return super().put(key, data)
+
+    lim = Limiter(upload_bps=2.0, class_caps={"ingest": 0.9}, burst=1e9)
+    inner = FailOnce()
+    rs = resilient(shaped(inner, lim),
+                   policy=RetryPolicy(deadline=10, max_attempts=3,
+                                      base=0.001), hedge=False)
+    try:
+        payload = b"x" * 4096
+        with qctx.applied(QosContext(0, 1, IOClass.INGEST)):
+            rs.put("k", payload)
+        assert inner.calls == 2
+        snap = lim.snapshot()
+        # both attempts charged, on the global AND the ingest sub-bucket
+        assert snap["upload"]["level_bytes"] <= 1e9 - 2 * 4096 + 200
+        assert snap["class_caps"]["upload/ingest"]["level_bytes"] \
+            <= 1e9 - 2 * 4096 + 200
+    finally:
+        rs.close()
+
+
+def test_store_download_limit_shapes_reads():
+    """CachedStore with --download-limit: measured object-plane read
+    throughput lands within +-10% of the cap (burst included in the
+    budget window)."""
+    bs = 64 << 10
+    cap = 8e6  # 8 MB/s
+    conf = ChunkConfig(block_size=bs, cache_size=1, hedge=False,
+                       download_limit=cap,
+                       limiter=Limiter(download_bps=cap, burst=bs))
+    store = CachedStore(MemStorage("shapedread"), conf)
+    try:
+        n = 24
+        for i in range(n):
+            store.storage.put(block_key(9, i, bs), b"d" * bs)
+        t0 = time.monotonic()
+        moved = 0
+        for i in range(n):
+            moved += len(store._load_block(block_key(9, i, bs), bs,
+                                           cache_after=False))
+        measured = moved / (time.monotonic() - t0)
+        # the initial burst (1 block) rides for free; fold it out
+        budget = cap + bs / (moved / cap)
+        assert abs(measured - budget) / budget < 0.15, \
+            f"{measured/1e6:.2f} MB/s vs cap {cap/1e6:.1f}"
+    finally:
+        store.close()
+
+
+# -- the chaos-style drill (ISSUE 6 satellite) -----------------------------
+
+class _SlowStore(MemStorage):
+    """Fixed per-GET latency: makes worker occupancy the contended
+    resource, like a real object backend."""
+
+    def __init__(self, delay=0.008):
+        super().__init__("slow")
+        self.delay = delay
+
+    def get(self, key, off=0, limit=-1):
+        time.sleep(self.delay)
+        return super().get(key, off, limit)
+
+
+def test_drill_background_scan_under_foreground_reads():
+    """A saturating BACKGROUND scan under a FOREGROUND read stream:
+    foreground read p99 stays bounded (the scan cannot occupy the
+    reserved worker or jump the queue), the scan keeps progressing
+    (starvation floor), and an overdriven prefetch window sheds."""
+    bs = 8 << 10
+    delay = 0.008
+    sched = Scheduler()
+    conf = ChunkConfig(block_size=bs, cache_size=1 << 30, hedge=False,
+                       max_download=4, scheduler=sched)
+    store = CachedStore(_SlowStore(delay), conf)
+    try:
+        # foreground slice: 4 blocks; background keys: disjoint slice ids
+        fg_len = 4 * bs
+        for i in range(4):
+            store.storage.put(block_key(1, i, bs), b"f" * bs)
+        bg_keys = [block_key(2 + i, 0, bs) for i in range(400)]
+        for k in bg_keys:
+            store.storage.put(k, b"b" * bs)
+
+        def fg_read():
+            t0 = time.perf_counter()
+            got = store.new_reader(1, fg_len).read(0, fg_len)
+            assert len(got) == fg_len
+            store.evict_cache(1, fg_len)  # force real loads next time
+            return time.perf_counter() - t0
+
+        # idle baseline
+        idle = sorted(fg_read() for _ in range(30))
+        idle_p99 = idle[-1]
+
+        # background scan saturating the download lane
+        from juicefs_tpu.chunk.parallel import fetch_ordered
+
+        stop = threading.Event()
+        bg_done = [0]
+
+        def scan():
+            def keys():
+                while not stop.is_set():
+                    yield from bg_keys
+            for _ in fetch_ordered(
+                keys(),
+                lambda k: store._load_block(k, bs, cache_after=False),
+                store._bulk_pool, 16,
+            ):
+                bg_done[0] += 1
+                if stop.is_set():
+                    break
+
+        t = threading.Thread(target=scan, daemon=True)
+        t.start()
+        time.sleep(0.2)  # let the scan saturate
+
+        mixed = sorted(fg_read() for _ in range(30))
+        mixed_p99 = mixed[-1]
+        bg_during = bg_done[0]
+        stop.set()
+        t.join(10)
+
+        assert bg_during > 20, "background scan starved"
+        # p99 bound: generous for CI noise, but far below the ~1s tail a
+        # FIFO pool would produce with a 400-deep backlog of 8ms GETs
+        assert mixed_p99 < max(8 * idle_p99, 0.25), \
+            f"idle p99 {idle_p99*1e3:.1f}ms -> mixed p99 {mixed_p99*1e3:.1f}ms"
+
+        # overdriven prefetch sheds instead of backpressuring
+        dropped0 = _counter("juicefs_prefetch_dropped").value
+        for i in range(300):
+            store._fetcher.fetch((block_key(500 + i, 0, bs), bs))
+        assert _counter("juicefs_prefetch_dropped").value > dropped0
+    finally:
+        store.close()
+        sched.close()
+
+
+def test_store_close_leaves_shared_scheduler_running():
+    """Two stores on one scheduler: closing the first drains only its own
+    work; the second keeps serving (ISSUE 6 shutdown-ordering satellite;
+    the conftest thread-leak guard covers the no-leak half)."""
+    sched = Scheduler()
+    bs = 4 << 10
+    s1 = CachedStore(MemStorage("a"), ChunkConfig(block_size=bs,
+                                                  scheduler=sched))
+    s2 = CachedStore(MemStorage("b"), ChunkConfig(block_size=bs,
+                                                  scheduler=sched))
+    try:
+        w = s1.new_writer(3)
+        w.write_at(b"z" * bs, 0)
+        w.finish(bs)
+        s1.close()
+        # the shared scheduler still serves the surviving store
+        w2 = s2.new_writer(4)
+        w2.write_at(b"y" * bs, 0)
+        w2.finish(bs)
+        assert s2._load_block(block_key(4, 0, bs), bs) == b"y" * bs
+        with pytest.raises(RuntimeError):
+            s1._pool.submit(lambda: None)
+    finally:
+        s2.close()
+        sched.close()
+
+
+def test_status_payload_exposes_qos():
+    sched = Scheduler()
+    conf = ChunkConfig(limiter=Limiter(download_bps=1e6), scheduler=sched)
+    store = CachedStore(MemStorage("st"), conf)
+    try:
+        snap = store.scheduler.snapshot()
+        assert "lanes" in snap and "classes" in snap
+        lim = store.limiter.snapshot()
+        assert lim["download"]["rate_bps"] == pytest.approx(1e6)
+    finally:
+        store.close()
+        sched.close()

@@ -38,6 +38,8 @@ def _populate_registry() -> None:
     import juicefs_tpu.object.metered       # noqa: F401  per-backend op meters
     import juicefs_tpu.object.resilient     # noqa: F401  retry/hedge/breaker
     import juicefs_tpu.object.sharding      # noqa: F401  shard routing counter
+    import juicefs_tpu.qos.limiter          # noqa: F401  bandwidth throttling
+    import juicefs_tpu.qos.scheduler        # noqa: F401  scheduler classes
     import juicefs_tpu.tpu.pipeline         # noqa: F401  batch metrics
     from juicefs_tpu.metric import register_process_metrics
 
@@ -220,6 +222,95 @@ def lint_ingest_seam(path: str | None = None) -> list[str]:
     return problems
 
 
+# the QoS registry contract (ISSUE 6): the unified scheduler/limiter
+# series the chaos drill and the BENCH_r07 mixed-workload bench
+# counter-assert — a rename must fail CI, not silently zero a dashboard
+QOS_PREFIX = "juicefs_qos_"
+QOS_EXPECTED = {
+    "juicefs_qos_submitted",
+    "juicefs_qos_completed",
+    "juicefs_qos_shed",
+    "juicefs_qos_wait_seconds",
+    "juicefs_qos_queue_depth",
+    "juicefs_qos_throttle_wait_seconds",
+    "juicefs_qos_throttled_bytes",
+}
+
+
+def lint_qos(registry=None) -> list[str]:
+    """Pin the juicefs_qos_* registry: every expected series exists, and
+    no stray metric squats under the prefix unreviewed."""
+    from juicefs_tpu.metric import global_registry
+
+    if registry is None:
+        _populate_registry()
+    reg = registry or global_registry()
+    names = {m.name for m in reg.walk()}
+    problems = [
+        f"{name}: qos metric missing from the registry"
+        for name in sorted(QOS_EXPECTED - names)
+    ]
+    problems += [
+        f"{name}: unreviewed metric under {QOS_PREFIX} (add it to "
+        "QOS_EXPECTED in tools/lint_metrics.py)"
+        for name in sorted(n for n in names
+                           if n.startswith(QOS_PREFIX)
+                           and n not in QOS_EXPECTED)
+    ]
+    return problems
+
+
+# pools allowed to exist OUTSIDE the unified scheduler:
+#   - qos/ itself (the scheduler's own workers);
+#   - object/resilient.py (the elastic abandonment pool: a hung attempt
+#     must be abandonable, which a shared bounded worker set cannot do —
+#     the ISSUE 6 whitelisted resilience pool).
+_QOS_SEAM_WHITELIST = ("qos" + os.sep, os.path.join("object", "resilient.py"))
+
+
+def lint_qos_seam(root: str | None = None) -> list[str]:
+    """No-bare-pool check (ISSUE 6): every concurrency seam in the
+    package must ride the unified scheduler.  A module that spins up its
+    own ThreadPoolExecutor bypasses priority classes, tenant fairness,
+    shedding and the bandwidth budget — exactly the mutually-blind pool
+    sprawl the scheduler replaced, and nothing functional would catch the
+    regression (the work still completes, QoS just silently stops
+    applying to it)."""
+    import ast
+
+    root = root or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "juicefs_tpu",
+    )
+    problems: list[str] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if any(rel.startswith(w) or rel == w
+                   for w in _QOS_SEAM_WHITELIST):
+                continue
+            with open(path) as f:
+                src = f.read()
+            if "ThreadPoolExecutor" not in src:
+                continue
+            for node in ast.walk(ast.parse(src)):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = (getattr(node.func, "id", None)
+                        or getattr(node.func, "attr", None))
+                if name == "ThreadPoolExecutor":
+                    problems.append(
+                        f"juicefs_tpu/{rel}:{node.lineno}: bare "
+                        "ThreadPoolExecutor outside qos/ — submit through "
+                        "the unified scheduler "
+                        "(qos.global_scheduler().executor(lane, cls))"
+                    )
+    return problems
+
+
 def lint_resilience(root: str | None = None) -> list[str]:
     """Sibling check (ISSUE 3): every `create_storage` consumer inside the
     package must reach the backend through the resilience wrapper — either
@@ -269,7 +360,8 @@ def lint_resilience(root: str | None = None) -> list[str]:
 
 def main() -> int:
     problems = (lint() + lint_cache_group() + lint_ingest()
-                + lint_ingest_seam() + lint_resilience())
+                + lint_ingest_seam() + lint_resilience()
+                + lint_qos() + lint_qos_seam())
     if problems:
         for p in problems:
             print(f"lint_metrics: {p}", file=sys.stderr)

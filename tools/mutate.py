@@ -62,6 +62,8 @@ TEST_MAP = {
     "juicefs_tpu/object/fault": ["tests/test_resilient.py",
                                  "tests/test_chaos.py"],
     "juicefs_tpu/tpu/jth256": ["tests/test_tpu_hash.py"],
+    "juicefs_tpu/qos/scheduler": ["tests/test_qos.py"],
+    "juicefs_tpu/qos/limiter": ["tests/test_qos.py"],
 }
 DEFAULT_TESTS = ["tests/test_meta.py", "tests/test_vfs.py"]
 
