@@ -192,3 +192,6 @@ class PeerBlockServer:
             self._httpd.close_all_connections()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

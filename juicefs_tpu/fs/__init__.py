@@ -16,6 +16,7 @@ from typing import Optional
 
 from ..meta.context import Context
 from ..meta.types import Attr, Entry, TYPE_DIRECTORY, TYPE_FILE, TYPE_SYMLINK
+from ..utils import lockwatch
 from ..vfs import ROOT_INO, VFS
 
 __all__ = ["FileSystem", "File", "FSError"]
@@ -330,7 +331,15 @@ class File:
         return bytes(out)
 
     def read(self, size: int = -1) -> bytes:
-        with self._lock:
+        # Intentional hold-while-blocking: POSIX offset atomicity — two
+        # concurrent read()s on ONE handle must advance the shared
+        # position and get disjoint data, and how far it advances is
+        # only known after the read returns.  Deadlock-free: File sits
+        # at the top of the stack; no layer below takes a File lock.
+        with self._lock, lockwatch.permit(
+                "per-handle offset atomicity: the position advance is "
+                "only known after the read; lower layers never take "
+                "File._lock"):
             data = self.pread(self._pos, size)
             self._pos += len(data)
             return data
@@ -342,7 +351,11 @@ class File:
         return len(data)
 
     def write(self, data: bytes) -> int:
-        with self._lock:
+        # Same per-handle offset contract as read() above (a synchronous
+        # flush inside vfs.write may reach the object store).
+        with self._lock, lockwatch.permit(
+                "per-handle offset atomicity: same contract as "
+                "File.read; lower layers never take File._lock"):
             n = self.pwrite(self._pos, data)
             self._pos += n
             return n

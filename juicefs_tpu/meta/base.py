@@ -247,6 +247,11 @@ class BaseMeta(interface.Meta):
 
     def close_session(self) -> None:
         self._stop.set()
+        hb = self._heartbeat
+        if hb is not None and hb.is_alive() \
+                and hb is not threading.current_thread():
+            hb.join(timeout=10.0)  # _stop wakes the refresher immediately
+            self._heartbeat = None
         if self.sid:
             self.do_clean_session(self.sid)
             self.sid = 0

@@ -84,7 +84,10 @@ class RedisServer:
 
     def _pub_loop(self) -> None:
         while True:
-            ch, push, conns = self._pub_q.get()
+            item = self._pub_q.get()
+            if item is None:  # stop() sentinel
+                return
+            ch, push, conns = item
             for c in conns:
                 try:
                     c._send_push(push)
@@ -232,6 +235,11 @@ class RedisServer:
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> int:
+        if not self._pub_thread.is_alive():  # re-start after a stop()
+            self._pub_thread = threading.Thread(
+                target=self._pub_loop, daemon=True, name="pubsub-deliver"
+            )
+            self._pub_thread.start()
         if self.data_path:
             with self.lock:
                 self._replaying = True
@@ -267,6 +275,15 @@ class RedisServer:
             self._srv.shutdown()
             self._srv.server_close()
             self._srv = None
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self._pub_thread.is_alive():
+            # sentinel: drain then exit the delivery loop.  Guarded so a
+            # second stop() cannot park a stale sentinel in the queue
+            # that would kill the freshly re-spawned loop on restart.
+            self._pub_q.put(None)
+            self._pub_thread.join(timeout=10.0)
         self._aof_stop.set()
         with self.lock:
             if self._aof is not None:

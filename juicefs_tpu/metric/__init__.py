@@ -305,7 +305,9 @@ class MetricsServer:
         return self
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        self._httpd.shutdown()  # blocks until serve_forever exits
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
         self._httpd.server_close()
 
 
@@ -391,3 +393,4 @@ class MetricsPusher:
 
     def stop(self) -> None:
         self._stop.set()
+        self._thread.join(timeout=10.0)

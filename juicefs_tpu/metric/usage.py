@@ -69,3 +69,4 @@ class UsageReporter:
 
     def stop(self) -> None:
         self._stop.set()
+        self._thread.join(timeout=10.0)

@@ -89,6 +89,8 @@ class BackgroundJobs:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
 
     def _elect(self) -> bool:
         """Best-effort single-winner election via a lease object."""

@@ -32,7 +32,7 @@ from typing import Optional
 from ..chunk import CachedStore
 from ..meta.base import BaseMeta
 from ..meta.types import CHUNK_SIZE, Slice
-from ..utils import get_logger
+from ..utils import get_logger, lockwatch
 
 logger = get_logger("vfs.writer")
 
@@ -189,7 +189,16 @@ class FileWriter:
             return 0
 
     def flush(self) -> int:
-        with self.lock:
+        # Intentional hold-while-blocking: flush IS the per-file commit
+        # barrier — it waits out slice uploads under the file's own lock
+        # so concurrent writers/readers of THIS file serialize against
+        # the barrier.  Deadlock-free because upload-pool workers never
+        # take FileWriter locks (docs/ARCHITECTURE.md "Checked
+        # concurrency contracts").
+        with self.lock, lockwatch.permit(
+                "per-file flush barrier: upload workers never take "
+                "FileWriter.lock, so waiting them out under it cannot "
+                "cycle"):
             if self.err:
                 return self.err
             for indx in sorted(self.chunks):
@@ -222,7 +231,9 @@ class FileWriter:
             return any(c.slices for c in self.chunks.values())
 
     def _background_flush(self) -> None:
-        with self.lock:
+        with self.lock, lockwatch.permit(
+                "idle-slice flush: same per-file barrier contract as "
+                "FileWriter.flush"):
             deadline = time.monotonic() - FLUSH_IDLE_SEC
             for cw in list(self.chunks.values()):
                 cw.flush_idle(deadline)
@@ -237,7 +248,7 @@ class DataWriter:
         self.store = store
         self._files: dict[int, FileWriter] = {}
         self._lock = threading.Lock()
-        self._closed = False
+        self._stop = threading.Event()
         self._flusher = threading.Thread(
             target=self._flush_loop, args=(flush_interval,), daemon=True,
             name="vfs-writer-flush",
@@ -314,12 +325,12 @@ class DataWriter:
                 fw.length = length
 
     def close_all(self) -> None:
-        self._closed = True
+        self._stop.set()  # wake the flusher out of its interval sleep
         self.flush_all()
+        self._flusher.join(timeout=10.0)
 
     def _flush_loop(self, interval: float) -> None:
-        while not self._closed:
-            time.sleep(interval)
+        while not self._stop.wait(interval):
             with self._lock:
                 files = list(self._files.values())
             for fw in files:

@@ -27,10 +27,34 @@ if not os.environ.get("JFS_TEST_REAL_TPU"):
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Lock watchdog (ISSUE 7): instrument every juicefs lock across the whole
+# suite — acquisition-order inversions and holds-while-blocking become
+# test failures (the lockwatch_guard fixture below).  Installed BEFORE
+# any juicefs_tpu module creates a lock; set JUICEFS_LOCK_WATCHDOG=0 to
+# run uninstrumented.
+os.environ.setdefault("JUICEFS_LOCK_WATCHDOG", "1")
+from juicefs_tpu.utils import lockwatch  # noqa: E402
+
+lockwatch.install()
+
 
 import contextlib
 
 import pytest
+
+
+@pytest.fixture(autouse=True)
+def lockwatch_guard():
+    """Fail any test during which the lock watchdog recorded a new
+    violation (lock-order inversion or a blocking call made while a
+    watched lock is held)."""
+    before = len(lockwatch.violations())
+    yield
+    new = lockwatch.violations()[before:]
+    assert not new, "lock watchdog violations:\n" + "\n\n".join(
+        f"[{v['kind']}] {v['detail']} (thread {v['thread']})\n{v['stack']}"
+        for v in new
+    )
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,1046 @@
+"""Concurrency contract analyzer (ISSUE 7): the framework, the four
+analysis passes (each proven on a seeded-violation fixture), the
+suppression syntax, the CLI contract, and the runtime lock watchdog
+drills (deliberate ABBA interleave + hold-while-blocking)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tools.analyze import analyze, load_files, LockModel  # noqa: E402
+from tools.analyze.core import SourceFile  # noqa: E402
+
+
+def _write_tree(tmp_path, files: dict) -> str:
+    root = tmp_path / "fx"
+    root.mkdir(exist_ok=True)
+    for name, src in files.items():
+        p = root / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def _run(tmp_path, files: dict):
+    report = analyze(root=_write_tree(tmp_path, files), runtime=False)
+    return report
+
+
+def _rules(report):
+    return [(f.rule, f.line) for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# pass 1: lock-order
+
+ABBA = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._la = threading.Lock()
+            self._lb = threading.Lock()
+
+        def one(self):
+            with self._la:
+                with self._lb:
+                    pass
+
+        def two(self):
+            with self._lb:
+                self.helper()
+
+        def helper(self):
+            with self._la:
+                pass
+"""
+
+
+def test_lock_order_abba_cycle_fires(tmp_path):
+    report = _run(tmp_path, {"abba.py": ABBA})
+    cyc = [f for f in report.findings if f.rule == "lock-order"]
+    assert len(cyc) == 1, report.findings
+    msg = cyc[0].message
+    assert "A._la" in msg and "A._lb" in msg and "cycle" in msg
+    # both sites named, incl. the transitive one through helper()
+    assert "helper()" in msg
+
+
+def test_lock_order_nested_nonreentrant_fires(tmp_path):
+    report = _run(tmp_path, {"nest.py": """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def go(self):
+                with self._l:
+                    with self._l:
+                        pass
+    """})
+    hits = [f for f in report.findings if f.rule == "lock-order"]
+    assert len(hits) == 1 and "non-reentrant" in hits[0].message
+
+
+def test_lock_order_rlock_reentry_clean(tmp_path):
+    report = _run(tmp_path, {"re.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._l = threading.RLock()
+
+            def outer(self):
+                with self._l:
+                    self.inner()
+
+            def inner(self):
+                with self._l:
+                    pass
+    """})
+    assert [f for f in report.findings if f.rule == "lock-order"] == []
+
+
+def test_lock_order_consistent_order_clean(tmp_path):
+    """Same two locks, always taken in the same order: no cycle."""
+    report = _run(tmp_path, {"ok.py": """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def one(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+            def two(self):
+                with self._la:
+                    with self._lb:
+                        pass
+    """})
+    assert [f for f in report.findings if f.rule == "lock-order"] == []
+
+
+def test_lock_order_transitive_self_deadlock_via_helper(tmp_path):
+    """Extracting the re-acquisition into a helper must not launder the
+    self-deadlock (mutation survivor: the held-call edge filter)."""
+    report = _run(tmp_path, {"tsd.py": """
+        import threading
+
+        class TS:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def outer(self):
+                with self._l:
+                    self.helper()
+
+            def helper(self):
+                with self._l:
+                    pass
+    """})
+    hits = [f for f in report.findings if f.rule == "lock-order"]
+    assert len(hits) == 1 and "non-reentrant" in hits[0].message
+
+
+def test_lock_order_abba_with_rlock_member_via_helper(tmp_path):
+    """A cycle is a cycle even when one member is an RLock and its edge
+    is discovered through a call (mutation survivor: the rlock carve-out
+    must only exempt SELF-reentry, not cross-lock edges)."""
+    report = _run(tmp_path, {"rl.py": """
+        import threading
+
+        class RM:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.RLock()
+
+            def one(self):
+                with self._la:
+                    self.grab_b()
+
+            def grab_b(self):
+                with self._lb:
+                    pass
+
+            def two(self):
+                with self._lb:
+                    with self._la:
+                        pass
+    """})
+    hits = [f for f in report.findings if f.rule == "lock-order"]
+    assert len(hits) == 1 and "cycle" in hits[0].message
+
+
+def test_lock_order_two_overlapping_cycles_both_reported(tmp_path):
+    """{A,B} and {A,B,C} share nodes but are distinct deadlock shapes —
+    one finding each, rotations deduped."""
+    report = _run(tmp_path, {"mc.py": """
+        import threading
+
+        class MC:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._c = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+
+            def bc(self):
+                with self._b:
+                    with self._c:
+                        pass
+
+            def ca(self):
+                with self._c:
+                    with self._a:
+                        pass
+    """})
+    hits = [f for f in report.findings if f.rule == "lock-order"]
+    assert len(hits) == 2, [f.message for f in hits]
+    assert all("cycle" in f.message for f in hits)
+
+
+def test_pass_run_without_model_builds_one(tmp_path):
+    """Every pass's run(files) works standalone (model=None) — the
+    `model or LockModel(files)` default is load-bearing."""
+    from tools.analyze.passes import blocking, lane_graph, lock_order
+
+    files = load_files(_write_tree(tmp_path, {"sa.py": """
+        import threading
+        import time
+
+        class SA:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def bad(self):
+                with self._l:
+                    with self._l:
+                        time.sleep(1)
+    """}))
+    assert any("non-reentrant" in f.message for f in lock_order.run(files))
+    assert any("time.sleep()" in f.message for f in blocking.run(files))
+    assert lane_graph.run(files) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2: blocking-under-lock
+
+def test_blocking_future_result_under_lock_fires(tmp_path):
+    report = _run(tmp_path, {"bl.py": """
+        import threading
+
+        class E:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def bad(self, fut):
+                with self._l:
+                    return fut.result()
+    """})
+    hits = [f for f in report.findings if f.rule == "blocking-under-lock"]
+    assert len(hits) == 1 and "Future.result()" in hits[0].message
+
+
+def test_blocking_set_queue_sleep_event(tmp_path):
+    report = _run(tmp_path, {"bl2.py": """
+        import queue
+        import threading
+        import time
+
+        class F:
+            def __init__(self):
+                self._l = threading.Lock()
+                self._q = queue.Queue()
+                self._ev = threading.Event()
+
+            def q_block(self):
+                with self._l:
+                    return self._q.get()
+
+            def q_ok(self):
+                with self._l:
+                    return self._q.get(block=False)
+
+            def sleepy(self):
+                with self._l:
+                    time.sleep(1)
+
+            def ev(self):
+                with self._l:
+                    self._ev.wait()
+    """})
+    msgs = [f.message for f in report.findings
+            if f.rule == "blocking-under-lock"]
+    assert len(msgs) == 3, msgs
+    assert any("Queue.get()" in m for m in msgs)
+    assert any("time.sleep()" in m for m in msgs)
+    assert any("Event.wait()" in m for m in msgs)
+    # the block=False get is NOT flagged
+    assert not any("q_ok" in m for m in msgs)
+
+
+def test_blocking_condition_wait_exempt_unless_outer_lock(tmp_path):
+    report = _run(tmp_path, {"cond.py": """
+        import threading
+
+        class G:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._cond = threading.Condition()
+
+            def fine(self):
+                with self._cond:
+                    self._cond.wait()
+
+            def bad(self):
+                with self._outer:
+                    with self._cond:
+                        self._cond.wait()
+    """})
+    hits = [f for f in report.findings if f.rule == "blocking-under-lock"]
+    assert len(hits) == 1
+    assert "G._outer" in hits[0].message
+    assert "G._cond" not in hits[0].message.split("holding")[1]
+
+
+def test_blocking_driver_op_and_transitive_call(tmp_path):
+    report = _run(tmp_path, {"drv.py": """
+        import threading
+        import time
+
+        class H:
+            def __init__(self, storage):
+                self._l = threading.Lock()
+                self.storage = storage
+
+            def bad_put(self, key, data):
+                with self._l:
+                    self.storage.put(key, data)
+
+            def bad_indirect(self):
+                with self._l:
+                    self.helper()
+
+            def helper(self):
+                time.sleep(0.5)
+    """})
+    msgs = [f.message for f in report.findings
+            if f.rule == "blocking-under-lock"]
+    assert any("object-store put()" in m for m in msgs), msgs
+    assert any("helper()" in m and "time.sleep()" in m for m in msgs), msgs
+
+
+def test_blocking_module_level_lock(tmp_path):
+    """Bare `with _LOCK:` on a module-global lock resolves through the
+    module table (mutation survivor: module-lock collection)."""
+    report = _run(tmp_path, {"ml.py": """
+        import threading
+        import time
+
+        _L = threading.Lock()
+
+        def waity():
+            with _L:
+                time.sleep(1)
+    """})
+    hits = [f for f in report.findings if f.rule == "blocking-under-lock"]
+    assert len(hits) == 1 and "time.sleep()" in hits[0].message
+    assert "_L" in hits[0].message
+
+
+def test_blocking_foreign_two_chain_ambiguous_not_guessed(tmp_path):
+    """`peer._l` where two classes define `_l` must stay UNRESOLVED —
+    resolving it against the enclosing class would fabricate findings
+    (mutation survivor: the self-chain guard in resolve_lock)."""
+    report = _run(tmp_path, {"amb.py": """
+        import threading
+        import time
+
+        class AmbA:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def poke(self, peer):
+                with peer._l:
+                    time.sleep(1)
+
+        class AmbB:
+            def __init__(self):
+                self._l = threading.Lock()
+    """})
+    assert [f for f in report.findings
+            if f.rule == "blocking-under-lock"] == []
+
+
+def test_blocking_condition_wait_held_elsewhere_flags_outer(tmp_path):
+    """Condition.wait is exempt for ITS OWN lock even when the `with`
+    on the condition is not lexically visible — but an unrelated outer
+    lock held across the wait is still a finding."""
+    report = _run(tmp_path, {"cw.py": """
+        import threading
+
+        class CW:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._cond = threading.Condition()
+
+            def bad(self):
+                with self._outer:
+                    self._cond.wait()
+    """})
+    hits = [f for f in report.findings if f.rule == "blocking-under-lock"]
+    assert len(hits) == 1 and "CW._outer" in hits[0].message
+
+
+def test_blocking_deferred_lambda_not_flagged(tmp_path):
+    report = _run(tmp_path, {"lam.py": """
+        import threading
+
+        class I:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def ok(self, fut, cb):
+                with self._l:
+                    cb(lambda: fut.result())
+    """})
+    assert [f for f in report.findings
+            if f.rule == "blocking-under-lock"] == []
+
+
+# ---------------------------------------------------------------------------
+# pass 3: lane-graph
+
+def test_lane_self_block_fires(tmp_path):
+    report = _run(tmp_path, {"lane.py": """
+        class W:
+            def __init__(self, sched):
+                self._up = sched.executor("upload", None)
+
+            def work(self):
+                self._up.submit(self.task)
+
+            def task(self):
+                f = self._up.submit(self.leaf)
+                return f.result()
+
+            def leaf(self):
+                return 1
+    """})
+    hits = [f for f in report.findings if f.rule == "lane-graph"]
+    assert len(hits) == 1
+    assert "own" in hits[0].message and "upload" in hits[0].message
+
+
+def test_lane_undeclared_edge_fires_and_declared_clean(tmp_path):
+    src = """
+        class X:
+            def __init__(self, sched):
+                self._a = sched.executor("{a}", None)
+                self._b = sched.executor("{b}", None)
+
+            def work(self):
+                self._a.submit(self.task)
+
+            def task(self):
+                f = self._b.submit(self.leaf)
+                return f.result()
+
+            def leaf(self):
+                return 1
+    """
+    # slice -> download is declared: clean
+    report = _run(tmp_path, {"ok.py": src.format(a="slice", b="download")})
+    assert [f for f in report.findings if f.rule == "lane-graph"] == []
+    # download -> slice is NOT declared (and would complete a cycle)
+    report = _run(tmp_path, {"bad.py": src.format(a="download", b="slice")})
+    hits = [f for f in report.findings if f.rule == "lane-graph"]
+    assert any("undeclared" in f.message for f in hits), hits
+    assert any("cycle" in f.message for f in hits), hits
+
+
+def test_lane_map_and_container_waits_detected(tmp_path):
+    report = _run(tmp_path, {"m.py": """
+        class Y:
+            def __init__(self, sched):
+                self._a = sched.executor("bulk", None)
+
+            def work(self):
+                self._a.submit(self.task)
+
+            def task(self):
+                futs = []
+                futs.append(self._a.submit(self.leaf))
+                for f in futs:
+                    f.result()
+
+            def leaf(self):
+                return 1
+    """})
+    hits = [f for f in report.findings if f.rule == "lane-graph"]
+    assert len(hits) == 1 and "own" in hits[0].message
+
+
+def test_lane_fire_and_forget_clean(tmp_path):
+    report = _run(tmp_path, {"ff.py": """
+        class Z:
+            def __init__(self, sched):
+                self._a = sched.executor("upload", None)
+
+            def work(self):
+                self._a.submit(self.task)
+
+            def task(self):
+                self._a.submit(self.leaf)   # no wait: fine
+
+            def leaf(self):
+                return 1
+    """})
+    assert [f for f in report.findings if f.rule == "lane-graph"] == []
+
+
+def test_lane_local_executor_var_self_block(tmp_path):
+    """Function-LOCAL executor handles (`ex = sched.executor(...)`)
+    carry their lane too (mutation survivor: the locals table)."""
+    report = _run(tmp_path, {"lv.py": """
+        def work(sched):
+            ex = sched.executor("bulk", None)
+            ex.submit(task)
+
+        def task(sched):
+            ex2 = sched.executor("bulk", None)
+            f = ex2.submit(leaf)
+            return f.result()
+
+        def leaf():
+            return 1
+    """})
+    hits = [f for f in report.findings if f.rule == "lane-graph"]
+    assert len(hits) == 1 and "own" in hits[0].message
+
+
+def test_lane_fetch_ordered_blocks_caller(tmp_path):
+    """fetch_ordered(items, fn, pool) runs fn on pool's lane AND blocks
+    the caller on its futures — a lane-running caller handing it its own
+    lane is a self-wait (mutation survivor: fetch_ordered detection)."""
+    report = _run(tmp_path, {"fo.py": """
+        class FO:
+            def __init__(self, sched):
+                self._dl = sched.executor("download", None)
+
+            def work(self):
+                self._dl.submit(self.task)
+
+            def task(self, items):
+                return list(fetch_ordered(items, self.leaf, self._dl))
+
+            def leaf(self, item):
+                return item
+    """})
+    hits = [f for f in report.findings if f.rule == "lane-graph"]
+    assert len(hits) == 1, [f.message for f in hits]
+    assert "own" in hits[0].message and "download" in hits[0].message
+
+
+def test_real_lane_graph_discovers_bulk_download_edge():
+    """The pass is not vacuous on the real tree: emptying the declared
+    set must surface the known bulk -> download dependency."""
+    import tools.analyze.passes.lane_graph as lg
+
+    files = load_files()
+    model = LockModel(files)
+    saved = lg.DECLARED_LANE_EDGES
+    lg.DECLARED_LANE_EDGES = frozenset()
+    try:
+        findings = lg.run(files, model)
+    finally:
+        lg.DECLARED_LANE_EDGES = saved
+    assert any("bulk -> download" in f.message for f in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# pass 4: daemon/shutdown
+
+def test_thread_daemon_explicit_required(tmp_path):
+    report = _run(tmp_path, {"t.py": """
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+
+        def bad_spawn():
+            t2 = threading.Thread(target=print)
+            t2.start()
+            t2.join()
+    """})
+    hits = [f for f in report.findings if f.rule == "thread-daemon"]
+    assert len(hits) == 1 and hits[0].line == 9
+
+
+def test_thread_shutdown_reachability(tmp_path):
+    report = _run(tmp_path, {"s.py": """
+        import threading
+
+        class Kept:
+            def __init__(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+
+        class Stopped:
+            def __init__(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+    """})
+    hits = [f for f in report.findings if f.rule == "thread-shutdown"]
+    assert len(hits) == 1 and "Kept._t" in hits[0].message
+
+
+def test_thread_local_nondaemon_must_join(tmp_path):
+    report = _run(tmp_path, {"l.py": """
+        import threading
+
+        def leaky():
+            t = threading.Thread(target=print, daemon=False)
+            t.start()
+
+        def joined():
+            t = threading.Thread(target=print, daemon=False)
+            t.start()
+            t.join()
+    """})
+    hits = [f for f in report.findings if f.rule == "thread-shutdown"]
+    assert len(hits) == 1 and hits[0].line == 5
+
+
+def test_thread_shutdown_one_hop_helper_counts(tmp_path):
+    """Teardown may drain through ONE self-call hop; the helper's attr
+    references (including plain `x = self._t` loads) count as
+    reachability.  A teardown passing the handle to a module function
+    must neither crash the walk nor satisfy it by itself."""
+    report = _run(tmp_path, {"h.py": """
+        import threading
+
+        def ext_stop(t):
+            t.join()
+
+        class Hop:
+            def __init__(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+
+            def close(self):
+                self._drain()
+
+            def _drain(self):
+                t = self._t
+                t.join()
+
+        class Ext:
+            def __init__(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+
+            def close(self):
+                ext_stop(self._t)
+    """})
+    # Hop: reachable through the hop; Ext: `self._t` appears lexically
+    # in close() itself — both clean
+    assert [f for f in report.findings if f.rule == "thread-shutdown"] == []
+
+
+def test_thread_shutdown_kept_executor_needs_no_start(tmp_path):
+    """A kept ClassExecutor is live from construction (no .start()):
+    unreachable-from-teardown is a finding even without one."""
+    report = _run(tmp_path, {"x.py": """
+        class KeptEx:
+            def __init__(self, sched):
+                self._ex = sched.executor("upload", None)
+
+        class StoppedEx:
+            def __init__(self, sched):
+                self._ex = sched.executor("upload", None)
+
+            def close(self):
+                self._ex.shutdown()
+    """})
+    hits = [f for f in report.findings if f.rule == "thread-shutdown"]
+    assert len(hits) == 1 and "KeptEx._ex" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+def test_suppression_silences_with_reason(tmp_path):
+    report = _run(tmp_path, {"sup.py": """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def waity(self):
+                with self._l:
+                    time.sleep(0.1)  # analyze: allow(blocking-under-lock) -- drill: bounded 100ms calibration sleep
+    """})
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+    f, s = report.suppressed[0]
+    assert f.rule == "blocking-under-lock"
+    assert "calibration" in s.reason
+    assert report.stale == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    report = _run(tmp_path, {"nr.py": """
+        import threading
+        import time
+
+        class T:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def waity(self):
+                with self._l:
+                    time.sleep(0.1)  # analyze: allow(blocking-under-lock)
+    """})
+    rules = [f.rule for f in report.findings]
+    assert "suppression-syntax" in rules
+    # the malformed allow does NOT silence the underlying finding
+    assert "blocking-under-lock" in rules
+
+
+def test_suppression_standalone_comment_covers_next_line(tmp_path):
+    report = _run(tmp_path, {"nl.py": """
+        import threading
+        import time
+
+        class U:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def waity(self):
+                with self._l:
+                    # analyze: allow(blocking-under-lock) -- drill: next-line form
+                    time.sleep(0.1)
+    """})
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+def test_stale_suppression_reported(tmp_path):
+    report = _run(tmp_path, {"st.py": """
+        import time
+
+        def fine():
+            time.sleep(0.1)  # analyze: allow(blocking-under-lock) -- stale: no lock held anymore
+    """})
+    assert report.findings == []
+    assert len(report.stale) == 1
+    assert report.stale[0].rules == ("blocking-under-lock",)
+
+
+def test_suppression_wrong_rule_does_not_silence(tmp_path):
+    report = _run(tmp_path, {"wr.py": """
+        import threading
+        import time
+
+        class V:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def waity(self):
+                with self._l:
+                    time.sleep(0.1)  # analyze: allow(lock-order) -- wrong rule id
+    """})
+    assert any(f.rule == "blocking-under-lock" for f in report.findings)
+    assert len(report.stale) == 1   # the mismatched allow is stale
+
+
+# ---------------------------------------------------------------------------
+# the real tree + CLI contract
+
+def test_real_tree_is_clean_ast():
+    """The AST passes exit clean on the repo (every real violation fixed
+    or justified) — this is the tier-1 CI gate."""
+    report = analyze(runtime=False)
+    assert report.findings == [], "\n".join(
+        f.render() for f in report.findings)
+
+
+def test_real_tree_registry_pass_clean():
+    from tools.analyze.passes import metrics
+
+    assert metrics.run([]) == []
+
+
+def test_cli_exits_zero_and_json(tmp_path):
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--ast"],
+        capture_output=True, text=True, timeout=120, cwd=_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 0, p.stderr
+    assert "OK" in p.stdout
+
+
+def test_cli_fails_with_readable_output_on_fixture(tmp_path):
+    root = _write_tree(tmp_path, {"bad.py": """
+        import threading
+
+        def spawn():
+            threading.Thread(target=print).start()
+    """})
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--ast", "--root", root],
+        capture_output=True, text=True, timeout=120, cwd=_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 1
+    # file:line rule: message
+    assert "bad.py:5 thread-daemon:" in p.stderr
+    pj = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--ast", "--root", root,
+         "--json"],
+        capture_output=True, text=True, timeout=120, cwd=_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert pj.returncode == 1
+    doc = json.loads(pj.stdout)
+    assert doc["findings"][0]["rule"] == "thread-daemon"
+    assert doc["findings"][0]["line"] == 5
+
+
+def test_cli_stale_listing(tmp_path):
+    root = _write_tree(tmp_path, {"st.py": """
+        import time
+
+        def fine():
+            time.sleep(0.1)  # analyze: allow(blocking-under-lock) -- obsolete
+    """})
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--ast", "--root", root,
+         "--stale"],
+        capture_output=True, text=True, timeout=120, cwd=_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 0   # stale is a warning, not a failure
+    assert "stale-suppression" in p.stdout
+    assert "obsolete" in p.stdout
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    report = _run(tmp_path, {"syn.py": "def broken(:\n"})
+    assert any(f.rule == "parse" for f in report.findings)
+
+
+# ---------------------------------------------------------------------------
+# runtime lock watchdog drills
+
+from juicefs_tpu.utils import lockwatch  # noqa: E402
+
+
+def test_watchdog_catches_deliberate_abba():
+    """Graph-based: the two orders never actually interleave into a
+    deadlock here, yet the inversion is still reported."""
+    with lockwatch.scoped_state() as st:
+        a = lockwatch.watched_lock("drill.A")
+        b = lockwatch.watched_lock("drill.B")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        th = threading.Thread(target=t1, daemon=True)
+        th.start(); th.join()
+        th = threading.Thread(target=t2, daemon=True)
+        th.start(); th.join()
+        inv = [v for v in st.snapshot() if v["kind"] == "inversion"]
+    assert len(inv) == 1
+    assert "drill.A" in inv[0]["detail"] and "drill.B" in inv[0]["detail"]
+
+
+def test_watchdog_catches_hold_while_blocking():
+    from concurrent.futures import Future
+
+    with lockwatch.scoped_state() as st:
+        lk = lockwatch.watched_lock("drill.hold")
+        fut = Future()
+        threading.Thread(
+            target=lambda: (time.sleep(0.05), fut.set_result(1)),
+            daemon=True).start()
+        with lk:
+            assert fut.result(timeout=5) == 1
+        hits = [v for v in st.snapshot()
+                if v["kind"] == "holds-while-blocking"]
+    if not lockwatch.enabled():
+        pytest.skip("watchdog disabled in this run")
+    assert hits and "Future.result()" in hits[0]["detail"]
+    assert "drill.hold" in hits[0]["detail"]
+
+
+def test_watchdog_permit_suppresses_with_reason():
+    from concurrent.futures import Future
+
+    with lockwatch.scoped_state() as st:
+        lk = lockwatch.watched_lock("drill.permit")
+        fut = Future()
+        fut.set_result(None)
+        slow = Future()
+        threading.Thread(
+            target=lambda: (time.sleep(0.05), slow.set_result(1)),
+            daemon=True).start()
+        with lk, lockwatch.permit("drill: vetted barrier"):
+            slow.result(timeout=5)
+        assert [v for v in st.snapshot()
+                if v["kind"] == "holds-while-blocking"] == []
+    with pytest.raises(ValueError):
+        lockwatch.permit("")
+
+
+def test_watchdog_condition_wait_releases_own_lock():
+    with lockwatch.scoped_state() as st:
+        cond = threading.Condition(
+            lockwatch.watched_lock("drill.cv", rlock=True))
+
+        def waker():
+            time.sleep(0.05)
+            with cond:
+                cond.notify_all()
+
+        threading.Thread(target=waker, daemon=True).start()
+        with cond:
+            cond.wait(2.0)
+        assert st.snapshot() == []
+
+
+def test_watchdog_rlock_reentry_and_consistent_order_clean():
+    with lockwatch.scoped_state() as st:
+        r = lockwatch.watched_lock("drill.re", rlock=True)
+        with r:
+            with r:
+                pass
+        a = lockwatch.watched_lock("drill.oa")
+        b = lockwatch.watched_lock("drill.ob")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert st.snapshot() == []
+
+
+def test_watchdog_same_class_two_instances_nonreentrant():
+    """Two Lock instances born at one site, nested: flagged (two threads
+    doing this in opposite instance order deadlock)."""
+    with lockwatch.scoped_state() as st:
+        l1 = lockwatch.watched_lock("drill.cls")
+        l2 = lockwatch.watched_lock("drill.cls")
+        with l1:
+            with l2:
+                pass
+        inv = [v for v in st.snapshot() if v["kind"] == "inversion"]
+    assert len(inv) == 1 and "two instances" in inv[0]["detail"]
+
+
+def test_watchdog_nonparking_ops_under_lock_clean():
+    """The blocking set only fires when the op would actually PARK:
+    done-future exception(), non-full queue put, drained queue get with
+    block=False, set-event wait — all clean under a watched lock."""
+    import queue
+    from concurrent.futures import Future
+
+    with lockwatch.scoped_state() as st:
+        lk = lockwatch.watched_lock("drill.nonpark")
+        fut = Future()
+        fut.set_result(1)
+        q = queue.Queue(maxsize=4)
+        ev = threading.Event()
+        ev.set()
+        with lk:
+            assert fut.exception() is None
+            q.put("x")
+            assert q.get(block=False) == "x"
+            assert ev.wait(0.1)
+        assert [v for v in st.snapshot()
+                if v["kind"] == "holds-while-blocking"] == []
+
+
+def test_watchdog_pending_future_exception_under_lock_flags():
+    from concurrent.futures import Future
+
+    with lockwatch.scoped_state() as st:
+        lk = lockwatch.watched_lock("drill.exc")
+        fut = Future()
+        threading.Thread(
+            target=lambda: (time.sleep(0.05), fut.set_result(1)),
+            daemon=True).start()
+        with lk:
+            assert fut.exception(timeout=5) is None
+        hits = [v for v in st.snapshot()
+                if v["kind"] == "holds-while-blocking"]
+    if not lockwatch.enabled():
+        pytest.skip("watchdog disabled in this run")
+    assert hits and "Future.exception()" in hits[0]["detail"]
+
+
+def test_watchdog_install_noop_when_disabled(monkeypatch):
+    """install() must refuse to patch while the env gate is off — a
+    half-enabled watchdog would instrument production processes."""
+    monkeypatch.setenv("JUICEFS_LOCK_WATCHDOG", "0")
+    assert not lockwatch.enabled()
+    saved_flag = lockwatch._installed
+    saved_lock = threading.Lock
+    try:
+        lockwatch._installed = False
+        assert lockwatch.install() is False
+        assert threading.Lock is saved_lock, \
+            "install() patched factories while disabled"
+    finally:
+        lockwatch._installed = saved_flag
+        threading.Lock = saved_lock
+
+
+def test_watchdog_enabled_for_suite_and_factories_patched():
+    """conftest turns the watchdog on for the whole tier-1 run: locks
+    created inside juicefs_tpu are watched wrappers."""
+    if not lockwatch.enabled():
+        pytest.skip("watchdog disabled in this run")
+    from juicefs_tpu.chunk.singleflight import SingleFlight
+
+    sf = SingleFlight()
+    assert isinstance(sf._lock, lockwatch.WatchedLock), sf._lock
+    # and test-code locks stay raw
+    assert not isinstance(threading.Lock(), lockwatch.WatchedLock)

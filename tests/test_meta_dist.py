@@ -433,3 +433,22 @@ def test_cross_client_lock_wake_via_push(pair):
         f"(poll fallback was 5s)"
     )
     assert c2.setlk(CTX, ino, owner=2, ltype=c2.F_UNLCK, start=0, end=100) == 0
+
+
+def test_server_double_stop_then_restart_pub_loop_alive():
+    """A second stop() must not park a stale sentinel in the pub queue —
+    the next start() would re-spawn the delivery loop only for it to eat
+    the leftover None and exit, silently dropping every PUBLISH wake."""
+    from juicefs_tpu.meta.redis_server import RedisServer
+
+    srv = RedisServer()
+    srv.start()
+    srv.stop()
+    srv.stop()   # idempotent teardown (error path + fixture teardown)
+    try:
+        srv.start()
+        time.sleep(0.1)
+        assert srv._pub_thread.is_alive(), \
+            "pub delivery loop died right after restart (stale sentinel)"
+    finally:
+        srv.stop()

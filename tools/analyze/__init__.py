@@ -1,0 +1,61 @@
+"""`tools.analyze` — unified whole-repo static analysis (ISSUE 7).
+
+One entry point (`python -m tools.analyze`, exit 1 on unsuppressed
+findings), one shared AST walk (every file parsed once, one LockModel
+shared by the concurrency passes), one findings model, one suppression
+syntax:
+
+    # analyze: allow(<rule>) -- <written justification>
+
+Passes (docs/ARCHITECTURE.md "Checked concurrency contracts"):
+  lock-order           static ABBA-deadlock cycle detection
+  blocking-under-lock  no blocking call inside a `with <lock>` scope
+  lane-graph           qos lane submission graph acyclic, no self-waits
+  thread-daemon/-shutdown  explicit daemon=, teardown reachability
+  qos-seam / resilience-seam / ingest-seam  (migrated from lint_metrics)
+  metric-registry      runtime registry hygiene + pinned series
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401  (public API)
+    DEFAULT_ROOT,
+    REPO,
+    Finding,
+    Pass,
+    Report,
+    SourceFile,
+    Suppression,
+    apply_suppressions,
+    load_files,
+    run_passes,
+)
+from .passes import AST_PASSES, RUNTIME_PASSES  # noqa: F401
+from .passes import blocking, lane_graph, lock_order, metrics, seams, threads
+from .passes.locks import LockModel  # noqa: F401
+
+
+def analyze(root: str = DEFAULT_ROOT, runtime: bool = True,
+            files: list[SourceFile] | None = None) -> Report:
+    """Run every pass over one shared parse of `root`.
+
+    `runtime=False` skips the registry pass (pure-AST mode: fixture
+    trees, unit tests, environments without the package importable).
+    """
+    if files is None:
+        files = load_files(root)
+    model = LockModel(files)
+    findings: list[Finding] = []
+    for sf in files:
+        findings.extend(sf.bad_suppressions)
+        if sf.parse_error:
+            findings.append(Finding(sf.rel, 0, "parse", sf.parse_error))
+    findings.extend(lock_order.run(files, model))
+    findings.extend(blocking.run(files, model))
+    findings.extend(lane_graph.run(files, model))
+    findings.extend(threads.run(files))
+    findings.extend(seams.run(files))
+    if runtime:
+        findings.extend(metrics.run(files))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return apply_suppressions(findings, files)

@@ -1,0 +1,70 @@
+"""CLI runner: ``python -m tools.analyze``.
+
+Exit 1 on any unsuppressed finding, printed one per line as
+``file:line rule: message`` (the CI contract, tests/test_analysis.py).
+
+  --json    machine-readable report (findings, suppressed, stale)
+  --stale   list suppressions whose rule no longer fires on their line
+  --ast     skip the runtime metric-registry pass (pure-AST mode)
+  --root    analyze a different tree (fixtures, tests)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.analyze import DEFAULT_ROOT, analyze  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analyze")
+    ap.add_argument("--root", default=DEFAULT_ROOT)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--stale", action="store_true",
+                    help="list stale suppressions (rule no longer fires)")
+    ap.add_argument("--ast", action="store_true",
+                    help="skip the runtime metric-registry pass")
+    args = ap.parse_args(argv)
+
+    report = analyze(root=args.root, runtime=not args.ast)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in report.findings],
+            "suppressed": [
+                {"finding": f.as_dict(), "reason": s.reason,
+                 "comment_line": s.comment_line}
+                for f, s in report.suppressed
+            ],
+            "stale": [
+                {"file": s.file, "line": s.comment_line,
+                 "rules": list(s.rules), "reason": s.reason}
+                for s in report.stale
+            ],
+        }, indent=2))
+        return 1 if report.failed else 0
+
+    for f in report.findings:
+        print(f.render(), file=sys.stderr)
+    if args.stale:
+        for s in report.stale:
+            print(f"{s.file}:{s.comment_line} stale-suppression: "
+                  f"allow({','.join(s.rules)}) no longer matches a finding "
+                  f"(reason was: {s.reason})")
+    if report.failed:
+        print(f"tools.analyze: {len(report.findings)} unsuppressed "
+              f"finding(s)", file=sys.stderr)
+        return 1
+    print(f"tools.analyze: OK ({len(report.suppressed)} suppressed, "
+          f"{len(report.stale)} stale)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

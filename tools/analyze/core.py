@@ -1,0 +1,235 @@
+"""Whole-repo analysis framework core (ISSUE 7 tentpole).
+
+One shared walk: every ``.py`` file under the analysis root is read and
+ast-parsed exactly once into a :class:`SourceFile`; every pass runs over
+that shared list.  Passes return :class:`Finding` objects (``file:line``,
+rule id, message) and never print — rendering, suppression filtering and
+exit codes belong to the runner (``python -m tools.analyze``).
+
+Suppression contract (the justification-required syntax):
+
+    self._fut.result()   # analyze: allow(blocking-under-lock) -- <why>
+
+* ``allow(rule)`` names the rule id it silences (comma-separate several).
+* The ``-- reason`` is MANDATORY: an allow without one is itself a
+  finding (rule ``suppression-syntax``) — unexplained silencing is how
+  prose contracts rotted into this PR's motivation.
+* A comment alone on a line applies to the next source line; a trailing
+  comment applies to its own line.
+* A suppression that no longer matches any finding is STALE; the runner
+  lists those under ``--stale`` so dead justifications get pruned.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_ROOT = os.path.join(REPO, "juicefs_tpu")
+
+_ALLOW_RE = re.compile(
+    r"#\s*analyze:\s*allow\(\s*([A-Za-z0-9_,\- ]*)\s*\)\s*(?:--\s*(\S.*))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analysis result, pinned to a source location."""
+
+    file: str       # path relative to the repo root ("" for registry-level)
+    line: int       # 1-based; 0 = whole-file / non-source finding
+    rule: str       # stable rule id (docs/ARCHITECTURE.md contract table)
+    message: str
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else (self.file or "-")
+        return f"{loc} {self.rule}: {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"file": self.file, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+
+@dataclass
+class Suppression:
+    """A parsed ``# analyze: allow(...)`` comment."""
+
+    file: str
+    comment_line: int   # where the comment physically sits
+    target_line: int    # the source line it silences
+    rules: tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed file: text, split lines, AST, and its suppressions.
+    Parsed exactly once; every pass shares this object."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel            # repo-relative, forward slashes
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        self.suppressions: list[Suppression] = []
+        self.bad_suppressions: list[Finding] = []
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        for i, comment in self._comments():
+            m = _ALLOW_RE.search(comment)
+            if m is None:
+                continue
+            rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+            reason = (m.group(2) or "").strip()
+            # a comment alone on its line silences the NEXT line
+            alone = self.lines[i - 1].strip().startswith("#")
+            target = i + 1 if alone else i
+            if not rules or not reason:
+                self.bad_suppressions.append(Finding(
+                    self.rel, i, "suppression-syntax",
+                    "analyze: allow(...) needs a rule id and a written "
+                    "justification: `# analyze: allow(<rule>) -- <reason>`",
+                ))
+                continue
+            self.suppressions.append(
+                Suppression(self.rel, i, target, rules, reason))
+
+    def _comments(self):
+        """(line, text) for every REAL comment token — the allow-syntax
+        regex must never match prose inside a docstring or string
+        literal (that is how tools/ documentation kept registering as
+        live suppressions)."""
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # unparseable tail: fall back to line-scanning what we can
+            for i, raw in enumerate(self.lines, start=1):
+                stripped = raw.strip()
+                if stripped.startswith("#"):
+                    yield i, stripped
+
+
+@dataclass
+class Pass:
+    """One analysis pass: a name, the rule ids it may emit, and a
+    callable over the shared file list."""
+
+    name: str
+    rules: tuple[str, ...]
+    run: Callable[[list[SourceFile]], list[Finding]]
+    doc: str = ""
+
+
+def load_files(root: str = DEFAULT_ROOT) -> list[SourceFile]:
+    """Parse every .py under `root` once (the shared AST walk)."""
+    out: list[SourceFile] = []
+    root = os.path.abspath(root)
+    base = REPO if root.startswith(REPO) else os.path.dirname(root)
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, base).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                out.append(SourceFile(path, rel, f.read()))
+    return out
+
+
+@dataclass
+class Report:
+    """Everything one analysis run produced, pre-rendering."""
+
+    findings: list[Finding] = field(default_factory=list)   # unsuppressed
+    suppressed: list[tuple[Finding, Suppression]] = field(default_factory=list)
+    stale: list[Suppression] = field(default_factory=list)
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.findings)
+
+
+def apply_suppressions(findings: Iterable[Finding],
+                       files: list[SourceFile]) -> Report:
+    """Split findings into unsuppressed vs suppressed, marking which
+    allow-comments earned their keep (the rest are stale)."""
+    by_file: dict[str, list[Suppression]] = {}
+    for sf in files:
+        by_file.setdefault(sf.rel, []).extend(sf.suppressions)
+    report = Report()
+    for f in findings:
+        sup = None
+        for s in by_file.get(f.file, ()):
+            if f.rule in s.rules and f.line == s.target_line:
+                sup = s
+                break
+        if sup is None:
+            report.findings.append(f)
+        else:
+            sup.used = True
+            report.suppressed.append((f, sup))
+    for sf in files:
+        report.stale.extend(s for s in sf.suppressions if not s.used)
+    return report
+
+
+def run_passes(files: list[SourceFile], passes: Iterable[Pass]) -> Report:
+    """Run passes over the pre-parsed files and fold in the framework's
+    own findings (malformed suppressions, unparseable files)."""
+    findings: list[Finding] = []
+    for sf in files:
+        findings.extend(sf.bad_suppressions)
+        if sf.parse_error:
+            findings.append(Finding(sf.rel, 0, "parse", sf.parse_error))
+    for p in passes:
+        findings.extend(p.run(files))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return apply_suppressions(findings, files)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several passes; lived as copy-pasted walkers
+# in tools/lint_metrics.py before ISSUE 7)
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Bare callee name: `Foo(...)` and `pkg.mod.Foo(...)` both -> "Foo"."""
+    return getattr(node.func, "id", None) or getattr(node.func, "attr", None)
+
+
+def attr_chain(node: ast.AST) -> Optional[list[str]]:
+    """`self.store._pool` -> ["self", "store", "_pool"]; None when the
+    expression is not a pure name/attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def parent_map(tree: ast.AST) -> dict[int, ast.AST]:
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents

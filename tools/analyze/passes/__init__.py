@@ -1,0 +1,17 @@
+"""Analysis passes.  AST_PASSES share the pre-parsed file list (and a
+single LockModel, built once by the runner); RUNTIME_PASSES import the
+package and inspect live state (the metric registry)."""
+
+from __future__ import annotations
+
+from . import blocking, lane_graph, lock_order, metrics, seams, threads
+
+AST_PASSES = [
+    lock_order.PASS,
+    blocking.PASS,
+    lane_graph.PASS,
+    threads.PASS,
+    seams.PASS,
+]
+RUNTIME_PASSES = [metrics.PASS]
+ALL_PASSES = AST_PASSES + RUNTIME_PASSES

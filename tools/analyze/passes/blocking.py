@@ -1,0 +1,67 @@
+"""Blocking-under-lock lint (rule ``blocking-under-lock``).
+
+A thread that parks inside a ``with <lock>:`` scope pins every other
+thread that needs the lock for the full park — the shape behind the
+PR 6 pool-split deadlocks and most of this repo's historical stalls.
+The blocking set lives in :mod:`locks` (``LockModel._check_blocking``)
+and covers:
+
+* ``Future.result()`` / ``Future.exception()`` (incl. chained
+  ``pool.submit(...).result()`` — a scheduler wait under a lock);
+* ``Queue.get()/put()`` without ``block=False``;
+* ``Event.wait()``; ``time.sleep()``; ``Thread.join()``;
+* object-store driver ops (``.get/.put/.delete/.head/.copy`` on a
+  storage handle): network time under a lock starves the seam.
+
+``Condition.wait()`` releases its own lock while parked, so it is only
+flagged when OTHER locks stay held across the wait.  Calls into
+same-class/module helpers that block are flagged at the call site
+(transitive closure), since extracting the blocking op into a helper
+must not launder it.  Intentional sites carry
+``# analyze: allow(blocking-under-lock) -- reason``.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Pass, SourceFile
+from .locks import LockModel
+
+
+def run(files: list[SourceFile], model: LockModel | None = None
+        ) -> list[Finding]:
+    model = model or LockModel(files)
+    blocks = model.blocks_star()
+    findings: list[Finding] = []
+    for qual in sorted(model.funcs):
+        fi = model.funcs[qual]
+        # direct blocking ops under a held lock
+        for held, desc, line, released in fi.blocking:
+            still = tuple(k for k in held if k != released)
+            if not still:
+                continue
+            findings.append(Finding(
+                fi.file, line, "blocking-under-lock",
+                f"{desc} while holding {', '.join(still)} (in {qual}): "
+                "the lock is pinned for the whole wait",
+            ))
+        # calls (while holding) into helpers that block somewhere
+        for held, callee, line in fi.held_calls:
+            hit = blocks.get(callee)
+            if hit is None:
+                continue
+            desc, bfile, bline = hit
+            short = callee.rsplit("::", 1)[-1]
+            findings.append(Finding(
+                fi.file, line, "blocking-under-lock",
+                f"call to {short}() blocks ({desc} at {bfile}:{bline}) "
+                f"while holding {', '.join(held)} (in {qual})",
+            ))
+    return findings
+
+
+PASS = Pass(
+    name="blocking-under-lock",
+    rules=("blocking-under-lock",),
+    run=run,
+    doc="no blocking call (futures, queues, sleeps, driver I/O) under a lock",
+)

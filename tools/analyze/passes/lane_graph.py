@@ -1,0 +1,350 @@
+"""QoS lane-graph verifier (rule ``lane-graph``).
+
+PR 6 replaced the old pool-split deadlock rules with one convention: the
+scheduler's named lanes form an acyclic graph, and a task running ON a
+bounded lane never submits-and-waits on its OWN lane (with every worker
+parked in waiters, nothing runs the waited-on work).  Until this pass,
+that convention lived in prose (docs/ARCHITECTURE.md "Concurrency
+model").  Here it becomes checked:
+
+1. every ``X = <sched>.executor("<lane>", <class>)`` site is collected
+   (self-attrs, locals, ``with ... as ex``), giving each executor handle
+   a lane;
+2. every ``E.submit(fn, ...)`` / ``E.map(fn, ...)`` /
+   ``fetch_ordered(items, fn, E, ...)`` marks ``fn`` (resolved by unique
+   method/function name, lambdas scanned inline) as *running on* E's
+   lane, propagated through resolved same-class/module calls;
+3. a lane-running function that BLOCKS on another submit
+   (``E.submit(...).result()``, a local future's ``.result()``, a
+   blocking ``fetch_ordered``/``.map``) contributes a lane edge.
+
+Findings: a worker blocking on its own lane; a cycle in the combined
+(discovered + declared) graph; and any DISCOVERED edge missing from
+``DECLARED_LANE_EDGES`` below — new cross-lane waits must be declared
+here (and stay acyclic) to pass CI, which is exactly the review hook
+the prose rule never had.  Dynamic dispatch the static walk cannot see
+is covered at runtime by the lock watchdog's holds-while-blocking check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Finding, Pass, SourceFile, attr_chain
+from .locks import LockModel, class_id
+
+# The lane dependency graph the architecture allows (ARCHITECTURE.md
+# "Concurrency model"): slice-lane work fans block loads out on the
+# download lane; bulk commands read segments through the download lane.
+# Adding an edge here is a reviewed act; the pass fails on any cycle.
+DECLARED_LANE_EDGES: frozenset[tuple[str, str]] = frozenset({
+    ("slice", "download"),
+    ("bulk", "download"),
+})
+
+
+def _executor_lane(call: ast.AST) -> Optional[str]:
+    """Lane name when `call` is `<anything>.executor("<lane>", ...)`."""
+    if isinstance(call, ast.Call) and isinstance(call.func, ast.Attribute) \
+            and call.func.attr == "executor" and call.args \
+            and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+class _Lanes:
+    """Executor-handle -> lane tables, plus function lane assignments."""
+
+    def __init__(self, files: list[SourceFile], model: LockModel):
+        self.model = model
+        self.attr_lanes: dict[str, dict[str, str]] = {}   # cls -> attr -> lane
+        self.attr_owner: dict[str, set[str]] = {}          # attr -> classes
+        self.local_lanes: dict[str, dict[str, str]] = {}   # qual -> var -> lane
+        # function qual -> lanes it runs on (submit targets)
+        self.runs_on: dict[str, set[str]] = {}
+        # method/function simple name -> quals (unique-name resolution)
+        self.by_name: dict[str, list[str]] = {}
+        for qual in model.funcs:
+            name = qual.rsplit("::", 1)[-1].rsplit(".", 1)[-1].strip("<>")
+            self.by_name.setdefault(name, []).append(qual)
+        for sf in files:
+            if sf.tree is not None:
+                self._collect(sf)
+
+    def _collect(self, sf: SourceFile) -> None:
+        # class-attr executors
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                cid = class_id(sf, node.name)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        chain = attr_chain(sub.targets[0])
+                        lane = _executor_lane(sub.value)
+                        if lane and chain and len(chain) == 2 \
+                                and chain[0] == "self":
+                            self.attr_lanes.setdefault(
+                                cid, {})[chain[1]] = lane
+                            self.attr_owner.setdefault(
+                                chain[1], set()).add(cid)
+        # function-local executors (assignments and `with ... as ex`)
+        self._collect_locals(sf)
+
+    def _collect_locals(self, sf: SourceFile) -> None:
+        def scan_fn(fn, qual):
+            table = self.local_lanes.setdefault(qual, {})
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    lane = _executor_lane(node.value)
+                    if lane:
+                        table[node.targets[0].id] = lane
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        lane = _executor_lane(item.context_expr)
+                        if lane and isinstance(item.optional_vars, ast.Name):
+                            table[item.optional_vars.id] = lane
+
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_fn(node, f"{sf.rel}::{node.name}")
+            elif isinstance(node, ast.ClassDef):
+                cid = class_id(sf, node.name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        scan_fn(item, f"{cid}.{item.name}")
+
+    def lane_of(self, expr: ast.AST, qual: str, cls: Optional[str]
+                ) -> Optional[str]:
+        """Lane of an executor expression: local var, self-attr, or a
+        foreign attr resolved by unique name (`self.store._rpool`)."""
+        chain = attr_chain(expr)
+        if chain is None:
+            return _executor_lane(expr)   # chained: sched.executor(...).x?
+        if len(chain) == 1:
+            return self.local_lanes.get(qual, {}).get(chain[0])
+        if chain[0] == "self" and len(chain) == 2:
+            # a self attribute is class-local: resolve against THIS class
+            # only (falling through to unique-name here would alias e.g.
+            # the resilience layer's own `self._pool` onto CachedStore's)
+            if cls is None:
+                return None
+            return self.attr_lanes.get(cls, {}).get(chain[1])
+        owners = self.attr_owner.get(chain[-1], set())
+        if len(owners) == 1:
+            return self.attr_lanes[next(iter(owners))][chain[-1]]
+        return None
+
+    def mark_runs_on(self, fn_expr: ast.AST, lane: str, sf: SourceFile,
+                     qual: str, cls: Optional[str]) -> None:
+        """`fn_expr` (a submit/map target) runs on `lane`."""
+        if isinstance(fn_expr, ast.Lambda):
+            for node in ast.walk(fn_expr.body):
+                if isinstance(node, ast.Call):
+                    self.mark_runs_on(node.func, lane, sf, qual, cls)
+            return
+        chain = attr_chain(fn_expr)
+        if chain is None:
+            return
+        name = chain[-1]
+        quals = self.by_name.get(name, [])
+        if len(quals) == 1:
+            self.runs_on.setdefault(quals[0], set()).add(lane)
+        elif chain[0] == "self" and len(chain) == 2 and cls is not None:
+            qual2 = f"{cls}.{name}"
+            if qual2 in self.model.funcs:
+                self.runs_on.setdefault(qual2, set()).add(lane)
+
+
+def run(files: list[SourceFile], model: LockModel | None = None
+        ) -> list[Finding]:
+    model = model or LockModel(files)
+    lanes = _Lanes(files, model)
+    # blocking-submit lanes per function: (lane, file, line)
+    blocking: dict[str, list] = {}
+
+    by_rel = {s.rel: s for s in files}
+    for qual in sorted(model.funcs):
+        fi = model.funcs[qual]
+        sf = by_rel.get(fi.file)
+        if sf is None or sf.tree is None:
+            continue
+        fn_node = fi.node
+        if fn_node is None:
+            continue
+        # local futures: var -> lane (from `v = E.submit(...)`)
+        fut_lane: dict[str, str] = {}
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in ("submit",
+                                                                 "map"):
+                lane = lanes.lane_of(func.value, qual, fi.cls)
+                if lane is not None and node.args:
+                    lanes.mark_runs_on(node.args[0], lane, sf, qual, fi.cls)
+                    if func.attr == "map":
+                        # map() yields .result()s: blocking at the site
+                        blocking.setdefault(qual, []).append(
+                            (lane, fi.file, node.lineno))
+            # fetch_ordered(items, fn, pool, ...): runs fn on pool's lane
+            # and blocks the caller on its futures
+            if (getattr(func, "id", None) == "fetch_ordered"
+                    or getattr(func, "attr", None) == "fetch_ordered") \
+                    and len(node.args) >= 3:
+                lane = lanes.lane_of(node.args[2], qual, fi.cls)
+                if lane is not None:
+                    lanes.mark_runs_on(node.args[1], lane, sf, qual, fi.cls)
+                    blocking.setdefault(qual, []).append(
+                        (lane, fi.file, node.lineno))
+            # E.submit(...).result() chained
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in ("result", "exception") \
+                    and isinstance(func.value, ast.Call) \
+                    and isinstance(func.value.func, ast.Attribute) \
+                    and func.value.func.attr == "submit":
+                lane = lanes.lane_of(func.value.func.value, qual, fi.cls)
+                if lane is not None:
+                    blocking.setdefault(qual, []).append(
+                        (lane, fi.file, node.lineno))
+        # second sweep: assigned futures waited later in the same function.
+        # `v = E.submit(...)` tracks the var; `c[i] = E.submit(...)` /
+        # `c.append(E.submit(...))` marks the whole function as holding
+        # lane futures in a container — any later bare `.result()` on an
+        # untracked name is then a wait on that lane (RSlice._read shape).
+        container_lanes: set[str] = set()
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr == "submit":
+                lane = lanes.lane_of(node.value.func.value, qual, fi.cls)
+                if lane is None:
+                    continue
+                if isinstance(node.targets[0], ast.Name):
+                    fut_lane[node.targets[0].id] = lane
+                else:
+                    container_lanes.add(lane)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "append" and node.args \
+                    and isinstance(node.args[0], ast.Call) \
+                    and isinstance(node.args[0].func, ast.Attribute) \
+                    and node.args[0].func.attr == "submit":
+                lane = lanes.lane_of(node.args[0].func.value, qual, fi.cls)
+                if lane is not None:
+                    container_lanes.add(lane)
+        if fut_lane or container_lanes:
+            for node in ast.walk(fn_node):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("result", "exception") \
+                        and isinstance(node.func.value, ast.Name):
+                    name = node.func.value.id
+                    hit_lanes = [fut_lane[name]] if name in fut_lane \
+                        else sorted(container_lanes)
+                    for lane in hit_lanes:
+                        blocking.setdefault(qual, []).append(
+                            (lane, fi.file, node.lineno))
+
+    # close runs_on and blocking over resolved calls
+    runs_on = dict(lanes.runs_on)
+    changed = True
+    while changed:
+        changed = False
+        for qual, fi in model.funcs.items():
+            mine = runs_on.get(qual)
+            if not mine:
+                continue
+            for callee in fi.callees:
+                tgt = runs_on.setdefault(callee, set())
+                if not mine <= tgt:
+                    tgt.update(mine)
+                    changed = True
+    blocks_star: dict[str, list] = {q: list(v) for q, v in blocking.items()}
+    changed = True
+    while changed:
+        changed = False
+        for qual, fi in model.funcs.items():
+            mine = blocks_star.setdefault(qual, [])
+            have = {b[0] for b in mine}
+            for callee in fi.callees:
+                for lane, f, ln in blocks_star.get(callee, []):
+                    if lane not in have:
+                        mine.append((lane, f, ln))
+                        have.add(lane)
+                        changed = True
+
+    findings: list[Finding] = []
+    discovered: dict[tuple[str, str], tuple[str, int, str]] = {}
+    for qual in sorted(runs_on):
+        for src in sorted(runs_on[qual]):
+            for lane, f, ln in blocks_star.get(qual, []):
+                discovered.setdefault((src, lane), (f, ln, qual))
+    for (a, b), (f, ln, qual) in sorted(discovered.items()):
+        if a == b:
+            findings.append(Finding(
+                f, ln, "lane-graph",
+                f"{qual} runs on lane {a!r} and submit-and-waits on its own "
+                "lane: with every worker parked in waiters, nothing runs "
+                "the waited-on work",
+            ))
+        elif (a, b) not in DECLARED_LANE_EDGES:
+            findings.append(Finding(
+                f, ln, "lane-graph",
+                f"undeclared lane dependency {a} -> {b} (via {qual}): add "
+                "it to DECLARED_LANE_EDGES in tools/analyze/passes/"
+                "lane_graph.py after review, keeping the graph acyclic",
+            ))
+    # acyclicity of declared + discovered
+    graph: dict[str, set[str]] = {}
+    for a, b in set(discovered) | set(DECLARED_LANE_EDGES):
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+    cyc = _find_cycle(graph)
+    if cyc:
+        findings.append(Finding(
+            "tools/analyze/passes/lane_graph.py", 0, "lane-graph",
+            "lane graph has a cycle: " + " -> ".join(cyc) + " — a full "
+            "lane can park every worker of the next lane behind it",
+        ))
+    return findings
+
+
+def _find_cycle(graph: dict[str, set[str]]) -> Optional[list[str]]:
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in set(graph) | {b for v in graph.values()
+                                             for b in v}}
+    path: list[str] = []
+
+    def dfs(n: str) -> Optional[list[str]]:
+        color[n] = GRAY
+        path.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color[m] == GRAY:
+                return path[path.index(m):] + [m]
+            if color[m] == WHITE:
+                got = dfs(m)
+                if got:
+                    return got
+        path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(color):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got:
+                return got
+    return None
+
+
+PASS = Pass(
+    name="lane-graph",
+    rules=("lane-graph",),
+    run=run,
+    doc="qos lane submission graph stays acyclic; no worker blocks on "
+        "its own lane; new edges must be declared",
+)

@@ -1,0 +1,114 @@
+"""Lock-order analysis (rule ``lock-order``): the classic ABBA deadlock,
+caught statically.
+
+From the shared :class:`LockModel` this pass builds the static lock
+acquisition graph: an edge A -> B means some code path acquires B while
+holding A — either a lexically nested ``with``, or a call made inside a
+``with A:`` scope whose (transitive, same-class/module) callee acquires
+B.  Two findings fall out:
+
+* a CYCLE in the graph (A -> B somewhere, B -> A somewhere else): two
+  threads walking the two paths concurrently deadlock.  Exactly the
+  shape of the PR 1 mount deadlock and the pool-split deadlocks PR 6's
+  lane graph replaced — now a CI failure instead of a lucky test.
+* a NESTED re-acquisition of a non-reentrant lock (A -> A where A is a
+  plain ``threading.Lock``): self-deadlock on the spot.
+
+The graph is an over-approximation (paths are not proven concurrent);
+a justified ``# analyze: allow(lock-order) -- reason`` on the reported
+edge suppresses a vetted pair.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Pass, SourceFile
+from .locks import LockModel
+
+
+def _edges(model: LockModel) -> dict[tuple[str, str], tuple[str, int, str]]:
+    """(held, acquired) -> (file, line, how) for every acquisition event;
+    the FIRST site seen wins (deterministic: files and functions are
+    walked in sorted order)."""
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+    acq = model.acquires_star
+    for qual in sorted(model.funcs):
+        fi = model.funcs[qual]
+        for held, key, line in fi.nested:
+            for h in held:
+                edges.setdefault((h, key), (fi.file, line, f"in {qual}"))
+        for held, callee, line in fi.held_calls:
+            for key, _site in acq.get(callee, {}).items():
+                for h in held:
+                    if h != key or model.kind_of(key) != "rlock":
+                        edges.setdefault(
+                            (h, key),
+                            (fi.file, line,
+                             f"in {qual} via {callee.rsplit('::', 1)[-1]}()"))
+    return edges
+
+
+def _cycles(edges) -> list[list[str]]:
+    """Elementary cycles, deduped by node set (one finding per deadlock
+    shape, not one per rotation).  Graphs here are tiny — a bounded DFS
+    is plenty."""
+    graph: dict[str, list[str]] = {}
+    for a, b in edges:
+        if a != b:   # self-edges are the separate self-deadlock finding
+            graph.setdefault(a, []).append(b)
+    for outs in graph.values():
+        outs.sort()
+    seen_sets: set[frozenset] = set()
+    cycles: list[list[str]] = []
+
+    def dfs(start: str, node: str, path: list[str]) -> None:
+        for nxt in graph.get(node, ()):
+            if nxt == start:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(path[:])
+            elif nxt not in path and nxt > start and len(path) < 8:
+                # only walk nodes > start: each cycle found exactly once,
+                # from its smallest node
+                path.append(nxt)
+                dfs(start, nxt, path)
+                path.pop()
+
+    for n in sorted(graph):
+        dfs(n, n, [n])
+    return cycles
+
+
+def run(files: list[SourceFile], model: LockModel | None = None
+        ) -> list[Finding]:
+    model = model or LockModel(files)
+    edges = _edges(model)
+    findings: list[Finding] = []
+    # self-deadlock: nested acquisition of a non-reentrant lock
+    for (a, b), (file, line, how) in sorted(edges.items()):
+        if a == b and model.kind_of(a) == "lock":
+            findings.append(Finding(
+                file, line, "lock-order",
+                f"nested acquisition of non-reentrant lock {a} ({how}): "
+                "a thread already holding it deadlocks on the spot",
+            ))
+    for cyc in _cycles(edges):
+        ring = cyc + [cyc[0]]
+        sites = []
+        for a, b in zip(ring, ring[1:]):
+            f, ln, how = edges[(a, b)]
+            sites.append(f"{a} -> {b} at {f}:{ln} ({how})")
+        f0, l0, _ = edges[(ring[0], ring[1])]
+        findings.append(Finding(
+            f0, l0, "lock-order",
+            "lock acquisition cycle (ABBA deadlock): " + "; ".join(sites),
+        ))
+    return findings
+
+
+PASS = Pass(
+    name="lock-order",
+    rules=("lock-order",),
+    run=run,
+    doc="acyclic lock acquisition graph; no nested non-reentrant locks",
+)

@@ -1,0 +1,405 @@
+"""Shared lock/primitive inference for the concurrency passes.
+
+Builds, from the pre-parsed file list, a repo-wide model of:
+
+* lock-like attributes — ``self.X = threading.Lock()/RLock()/Condition()``
+  (or ``utils.Cond()``) assigned anywhere in a class, plus module-level
+  ``X = threading.Lock()`` — keyed ``Class.attr`` / ``module.py::name``;
+* condition aliasing — ``self._cond = threading.Condition(self._lock)``
+  makes acquiring ``_cond`` identical to acquiring ``_lock``;
+* other primitives the blocking pass needs: Event / Queue / Thread /
+  executor / store-like attributes;
+* per-function summaries: which locks a function acquires and which
+  blocking operations it performs, with the lock stack held at each
+  event, closed transitively over same-class / same-module calls.
+
+Resolution is deliberately conservative: ``with self._lock`` resolves via
+the enclosing class; a bare ``with _lock`` via the module table; a
+foreign chain (``obj.attr._lock``) resolves only when the terminal
+attribute name is defined by exactly ONE class in the repo (unique-name
+resolution) — ambiguous names stay unresolved rather than guessing.
+What static resolution cannot see (locks reached through dynamic
+dispatch), the runtime watchdog (juicefs_tpu/utils/lockwatch.py) covers.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core import SourceFile, attr_chain, call_name
+
+LOCK_FACTORIES = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Cond": "condition",   # juicefs_tpu.utils.Cond wraps a Condition
+}
+EVENT_FACTORIES = {"Event"}
+QUEUE_FACTORIES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+STORE_FACTORIES = {"create_storage", "resilient", "gated", "shaped", "metered"}
+# attribute names treated as object-store handles even without an inferred
+# assignment (the driver seam: .get/.put/... on these blocks on the network)
+STOREISH_NAMES = {"storage", "_storage"}
+# receiver names treated as Events without an inferred assignment
+EVENTISH_NAMES = {"done"}
+
+
+def class_id(sf: SourceFile, cls_name: str) -> str:
+    """File-scoped class identity: two files may both define a class X
+    without their locks/methods merging into one analysis node."""
+    return f"{sf.rel}::{cls_name}"
+
+
+def _factory_kind(node: ast.AST, table) -> Optional[str]:
+    """Kind when `node` is a call to one of the factory names (either
+    `threading.Lock()` / `queue.Queue()` or a bare imported name)."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name is None:
+        return None
+    if isinstance(table, dict):
+        return table.get(name)
+    return name if name in table else None
+
+
+@dataclass
+class LockInfo:
+    key: str            # "Class.attr" or "mod.py::name"
+    kind: str           # lock | rlock | condition
+    file: str
+    line: int
+    alias_of: Optional[str] = None   # Condition(self._lock) -> that lock
+
+
+@dataclass
+class FuncInfo:
+    """Per-function concurrency summary."""
+
+    qual: str          # "file.py::Class.method" or "file.py::func"
+    file: str
+    cls: Optional[str]            # file-scoped class id, or None
+    node: Optional[ast.AST] = None   # the def's AST (lane pass re-walks it)
+    # locks acquired lexically in this function: {key: first site line}
+    acquires: dict = field(default_factory=dict)
+    # resolved same-class/module callees
+    callees: set = field(default_factory=set)
+    # (held_keys_tuple, acquired_key, line): nested acquisition events
+    nested: list = field(default_factory=list)
+    # (held_keys_tuple, callee_qual, line): calls made while holding
+    held_calls: list = field(default_factory=list)
+    # blocking ops ANYWHERE in the function (held may be empty):
+    # (held_keys_tuple, op_desc, line, released_key_or_None)
+    blocking: list = field(default_factory=list)
+
+
+class LockModel:
+    def __init__(self, files: list[SourceFile]):
+        self.files = files
+        self.locks: dict[str, LockInfo] = {}
+        self.class_locks: dict[str, dict[str, LockInfo]] = {}
+        self.class_events: dict[str, set[str]] = {}
+        self.class_queues: dict[str, set[str]] = {}
+        self.class_threads: dict[str, set[str]] = {}
+        self.class_stores: dict[str, set[str]] = {}
+        self.module_locks: dict[str, dict[str, LockInfo]] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        self._known: set[str] = set()  # all resolvable qualnames, pre-walk
+        self._attr_owner: dict[str, set[str]] = {}  # lock attr -> class ids
+        for sf in files:
+            if sf.tree is not None:
+                self._collect_defs(sf)
+                for node in sf.tree.body:
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._known.add(f"{sf.rel}::{node.name}")
+                    elif isinstance(node, ast.ClassDef):
+                        cid = class_id(sf, node.name)
+                        for item in node.body:
+                            if isinstance(item, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef)):
+                                self._known.add(f"{cid}.{item.name}")
+        for sf in files:
+            if sf.tree is not None:
+                self._collect_funcs(sf)
+        self._close_acquires()
+
+    # -- definition collection --------------------------------------------
+    def _collect_defs(self, sf: SourceFile) -> None:
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = _factory_kind(node.value, LOCK_FACTORIES)
+                if kind:
+                    key = f"{sf.rel}::{node.targets[0].id}"
+                    info = LockInfo(key, kind, sf.rel, node.lineno)
+                    self.locks[key] = info
+                    self.module_locks.setdefault(sf.rel, {})[
+                        node.targets[0].id] = info
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = class_id(sf, node.name)
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+                    continue
+                chain = attr_chain(sub.targets[0])
+                if chain is None or len(chain) != 2 or chain[0] != "self":
+                    continue
+                attr = chain[1]
+                kind = _factory_kind(sub.value, LOCK_FACTORIES)
+                if kind:
+                    key = f"{cls}.{attr}"
+                    alias = None
+                    if kind == "condition" and isinstance(sub.value, ast.Call) \
+                            and sub.value.args:
+                        inner = attr_chain(sub.value.args[0])
+                        if inner and len(inner) == 2 and inner[0] == "self":
+                            alias = f"{cls}.{inner[1]}"
+                    info = LockInfo(key, kind, sf.rel, sub.lineno, alias)
+                    self.locks[key] = info
+                    self.class_locks.setdefault(cls, {})[attr] = info
+                    self._attr_owner.setdefault(attr, set()).add(cls)
+                elif _factory_kind(sub.value, EVENT_FACTORIES):
+                    self.class_events.setdefault(cls, set()).add(attr)
+                elif _factory_kind(sub.value, QUEUE_FACTORIES):
+                    self.class_queues.setdefault(cls, set()).add(attr)
+                elif _factory_kind(sub.value, {"Thread"}):
+                    self.class_threads.setdefault(cls, set()).add(attr)
+                elif _factory_kind(sub.value, STORE_FACTORIES):
+                    self.class_stores.setdefault(cls, set()).add(attr)
+
+    # -- lock expression resolution ---------------------------------------
+    def canonical(self, key: str) -> str:
+        """Follow Condition-over-lock aliases to the underlying lock."""
+        seen = set()
+        info = self.locks.get(key)
+        while info is not None and info.alias_of and key not in seen:
+            seen.add(key)
+            key = info.alias_of
+            info = self.locks.get(key)
+        return key
+
+    def resolve_lock(self, expr: ast.AST, sf: SourceFile,
+                     cls: Optional[str]) -> Optional[str]:
+        """Lock key for an acquisition expression, or None if unknown."""
+        chain = attr_chain(expr)
+        if chain is None:
+            return None
+        if len(chain) == 1:
+            info = self.module_locks.get(sf.rel, {}).get(chain[0])
+            return self.canonical(info.key) if info is not None else None
+        if chain[0] == "self" and len(chain) == 2 and cls is not None:
+            info = self.class_locks.get(cls, {}).get(chain[1])
+            if info is not None:
+                return self.canonical(info.key)
+        # foreign chain (`obj.x._lock`): unique-attribute-name resolution
+        owners = self._attr_owner.get(chain[-1], set())
+        if len(owners) == 1:
+            return self.canonical(f"{next(iter(owners))}.{chain[-1]}")
+        return None
+
+    def kind_of(self, key: str) -> str:
+        info = self.locks.get(key)
+        return info.kind if info is not None else "lock"
+
+    # -- function walk -----------------------------------------------------
+    def _collect_funcs(self, sf: SourceFile) -> None:
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_fn(node, f"{sf.rel}::{node.name}", sf, None)
+            elif isinstance(node, ast.ClassDef):
+                cid = class_id(sf, node.name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._walk_fn(item, f"{cid}.{item.name}", sf, cid)
+
+    def _walk_fn(self, fn, qual: str, sf: SourceFile, cls) -> FuncInfo:
+        fi = FuncInfo(qual, sf.rel, cls, fn)
+        self.funcs[qual] = fi
+        self._walk_stmts(fn.body, sf, cls, fi, held=())
+        return fi
+
+    def resolve_callee(self, call: ast.Call, sf: SourceFile, cls,
+                       scope: str = "") -> Optional[str]:
+        chain = attr_chain(call.func)
+        if chain is None:
+            return None
+        if len(chain) == 1:
+            for qual in (f"{scope}.<{chain[0]}>", f"{sf.rel}::{chain[0]}"):
+                if qual in self.funcs or qual in self._known:
+                    return qual
+            return None
+        if chain[0] == "self" and len(chain) == 2 and cls is not None:
+            qual = f"{cls}.{chain[1]}"
+            return qual if qual in self._known or qual in self.funcs else None
+        return None
+
+    def _walk_stmts(self, stmts, sf, cls, fi, held) -> None:
+        for st in stmts:
+            self._walk_stmt(st, sf, cls, fi, held)
+
+    def _walk_stmt(self, st: ast.stmt, sf, cls, fi: FuncInfo, held) -> None:
+        if isinstance(st, ast.With):
+            inner = held
+            for item in st.items:
+                key = self.resolve_lock(item.context_expr, sf, cls)
+                if key is not None:
+                    fi.acquires.setdefault(key, item.context_expr.lineno)
+                    if inner:
+                        fi.nested.append((inner, key,
+                                          item.context_expr.lineno))
+                    inner = inner + (key,)
+                else:
+                    self._scan_expr(item.context_expr, sf, cls, fi, held)
+            self._walk_stmts(st.body, sf, cls, fi, inner)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def's body runs when CALLED, not here: summarize it
+            # under a scoped name so call sites can resolve it, and do not
+            # inherit the current lock stack into it
+            self._walk_fn(st, f"{fi.qual}.<{st.name}>", sf, cls)
+            return
+        for _field, value in ast.iter_fields(st):
+            if isinstance(value, ast.stmt):
+                self._walk_stmt(value, sf, cls, fi, held)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self._walk_stmt(v, sf, cls, fi, held)
+                    elif isinstance(v, ast.expr):
+                        self._scan_expr(v, sf, cls, fi, held)
+            elif isinstance(value, ast.expr):
+                self._scan_expr(value, sf, cls, fi, held)
+
+    def _scan_expr(self, expr: ast.expr, sf, cls, fi: FuncInfo, held) -> None:
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                # a lambda body runs when CALLED, not where it is written:
+                # `cb(lambda: fut.result())` under a lock defers the wait
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve_callee(node, sf, cls, scope=fi.qual)
+            if callee is not None:
+                fi.callees.add(callee)
+                if held:
+                    fi.held_calls.append((held, callee, node.lineno))
+            self._check_blocking(node, sf, cls, fi, held)
+
+    # -- blocking-op detection (consumed by passes/blocking.py) ------------
+    # The configurable blocking set: operations that park the calling
+    # thread for unbounded/IO time.  Extend here, not in the pass.
+    def _check_blocking(self, call: ast.Call, sf, cls, fi: FuncInfo,
+                        held) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr in ("result",
+                                                             "exception"):
+            # any .result()/.exception() call is a future wait — covers
+            # `fut.result()` AND chained `pool.submit(...).result()`
+            fi.blocking.append((held, f"Future.{func.attr}()",
+                                call.lineno, None))
+            return
+        chain = attr_chain(func)
+        if chain is None:
+            return
+        tail, recv = chain[-1], chain[:-1]
+        desc = released = None
+        if chain in (["time", "sleep"], ["_time", "sleep"]):
+            desc = "time.sleep()"
+        elif tail == "wait" and recv:
+            key = self.resolve_lock(call.func.value, sf, cls)
+            if key is not None and (self.kind_of(key) == "condition"
+                                    or key in held):
+                # Condition.wait releases its own lock while blocked —
+                # only the OTHER held locks make it a finding
+                desc, released = "Condition.wait()", key
+            elif (cls is not None and recv[0] == "self" and len(recv) == 2
+                    and recv[1] in self.class_events.get(cls, set())) \
+                    or recv[-1] in EVENTISH_NAMES \
+                    or recv[-1].endswith("event"):
+                desc = "Event.wait()"
+        elif tail in ("get", "put") and recv:
+            is_queue = (cls is not None and recv[0] == "self" and len(recv) == 2
+                        and recv[1] in self.class_queues.get(cls, set()))
+            is_store = (recv[-1] in STOREISH_NAMES
+                        or (cls is not None and recv[0] == "self"
+                            and len(recv) == 2
+                            and recv[1] in self.class_stores.get(cls, set())))
+            if is_queue and not _queue_nonblocking(call):
+                desc = f"Queue.{tail}()"
+            elif is_store:
+                desc = f"object-store {tail}()"
+        elif tail in ("delete", "head", "copy") and recv and (
+                recv[-1] in STOREISH_NAMES
+                or (cls is not None and recv[0] == "self" and len(recv) == 2
+                    and recv[1] in self.class_stores.get(cls, set()))):
+            desc = f"object-store {tail}()"
+        elif tail == "join" and recv and (
+                recv[-1] in self.class_threads.get(cls or "", set())
+                or recv[-1] in ("_thread", "_finalizer")):
+            desc = "Thread.join()"
+        if desc is not None:
+            fi.blocking.append((held, desc, call.lineno, released))
+
+    # -- transitive closures ----------------------------------------------
+    def _close_acquires(self) -> None:
+        """acquires*(fn): locks reachable through resolved calls, with the
+        site that introduced each (fixpoint; call cycles are fine)."""
+        self.acquires_star: dict[str, dict[str, tuple]] = {
+            q: {k: (fi.file, ln) for k, ln in fi.acquires.items()}
+            for q, fi in self.funcs.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.funcs.items():
+                mine = self.acquires_star[q]
+                for callee in fi.callees:
+                    for k, site in self.acquires_star.get(callee, {}).items():
+                        if k not in mine:
+                            mine[k] = site
+                            changed = True
+
+    def blocks_star(self) -> dict[str, tuple]:
+        """fn -> (op_desc, file, line) for functions containing a blocking
+        op anywhere, closed over resolved calls.  Lets the blocking pass
+        flag `with L: self.foo()` where foo() parks the thread."""
+        out: dict[str, tuple] = {}
+        for q, fi in self.funcs.items():
+            for _held, desc, line, released in fi.blocking:
+                if released is None:   # Condition.wait handled separately
+                    out.setdefault(q, (desc, fi.file, line))
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.funcs.items():
+                if q in out:
+                    continue
+                for callee in fi.callees:
+                    if callee in out:
+                        desc, f, ln = out[callee]
+                        short = callee.rsplit("::", 1)[-1]
+                        out[q] = (f"{short}() -> {desc}", f, ln)
+                        changed = True
+                        break
+        return out
+
+
+def _queue_nonblocking(call: ast.Call) -> bool:
+    """True for Queue.get/put calls that cannot park the caller
+    (block=False, or the positional block argument is False)."""
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    for pos in call.args[:2]:   # get(block) / put(item, block)
+        if isinstance(pos, ast.Constant) and pos.value is False:
+            return True
+    return False

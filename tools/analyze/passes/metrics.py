@@ -1,0 +1,135 @@
+"""Metric-registry lint as a framework pass (rule ``metric-registry``).
+
+The one RUNTIME pass: it imports the metric-registering modules and
+walks the live registry (naming/help/conflict hygiene plus the pinned
+per-subsystem series sets from PRs 4/5/6).  It takes no source files and
+emits registry-level findings (no file:line — these are fixed, never
+suppressed).  Skipped when the runner is asked for AST-only analysis
+(fixture trees, unit tests).
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Pass, SourceFile
+
+# pinned per-subsystem series (ISSUE 4/5/6 contracts): tests and the
+# BENCHMARKS tables counter-assert these — a rename must fail CI, not
+# silently zero a dashboard
+CACHE_GROUP_PREFIX = "juicefs_cache_group_"
+CACHE_GROUP_EXPECTED = {
+    "juicefs_cache_group_peer_hits",
+    "juicefs_cache_group_peer_misses",
+    "juicefs_cache_group_peer_errors",
+    "juicefs_cache_group_ring_size",
+    "juicefs_cache_group_peer_get_seconds",
+    "juicefs_cache_group_served",
+    "juicefs_cache_group_served_bytes",
+    "juicefs_cache_group_serve_misses",
+}
+INGEST_PREFIX = "juicefs_ingest_"
+INGEST_EXPECTED = {
+    "juicefs_ingest_blocks",
+    "juicefs_ingest_bytes",
+    "juicefs_ingest_put_elided",
+    "juicefs_ingest_put_elided_bytes",
+    "juicefs_ingest_uploaded",
+    "juicefs_ingest_passthrough",
+    "juicefs_ingest_race_collapsed",
+    "juicefs_ingest_errors",
+    "juicefs_ingest_queue_blocks",
+}
+QOS_PREFIX = "juicefs_qos_"
+QOS_EXPECTED = {
+    "juicefs_qos_submitted",
+    "juicefs_qos_completed",
+    "juicefs_qos_shed",
+    "juicefs_qos_wait_seconds",
+    "juicefs_qos_queue_depth",
+    "juicefs_qos_throttle_wait_seconds",
+    "juicefs_qos_throttled_bytes",
+}
+
+
+def populate_registry() -> None:
+    """Import the modules whose metrics register at import time, and the
+    runtime registrations that are cheap to trigger."""
+    import juicefs_tpu.cache.group          # noqa: F401  peer hit/miss/ring
+    import juicefs_tpu.cache.server         # noqa: F401  peer served counters
+    import juicefs_tpu.chunk.cached_store   # noqa: F401  staging gauges
+    import juicefs_tpu.chunk.disk_cache     # noqa: F401  disk tier counters
+    import juicefs_tpu.chunk.ingest         # noqa: F401  inline-dedup counters
+    import juicefs_tpu.chunk.mem_cache      # noqa: F401  cache hit/miss/evict
+    import juicefs_tpu.chunk.parallel       # noqa: F401  fetch_inflight gauge
+    import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
+    import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
+    import juicefs_tpu.metric.trace         # noqa: F401  stage rollup histogram
+    import juicefs_tpu.object.metered       # noqa: F401  per-backend op meters
+    import juicefs_tpu.object.resilient     # noqa: F401  retry/hedge/breaker
+    import juicefs_tpu.object.sharding      # noqa: F401  shard routing counter
+    import juicefs_tpu.qos.limiter          # noqa: F401  bandwidth throttling
+    import juicefs_tpu.qos.scheduler        # noqa: F401  scheduler classes
+    import juicefs_tpu.tpu.pipeline         # noqa: F401  batch metrics
+    from juicefs_tpu.metric import register_process_metrics
+
+    register_process_metrics()
+
+
+def _registry(registry=None):
+    from juicefs_tpu.metric import global_registry
+
+    if registry is None:
+        populate_registry()
+    return registry or global_registry()
+
+
+def lint_registry(registry=None) -> list[str]:
+    """Naming/help/conflict hygiene over the registry (legacy `lint()`
+    contract: returns problem strings, empty = clean)."""
+    reg = _registry(registry)
+    problems: list[str] = []
+    for m in reg.walk():
+        if not m.name.startswith("juicefs_"):
+            problems.append(f"{m.name}: metric name lacks the juicefs_ prefix")
+        if not m.help.strip():
+            problems.append(f"{m.name}: missing help string")
+        if m.kind not in ("counter", "gauge", "histogram"):
+            problems.append(f"{m.name}: unknown metric kind {m.kind!r}")
+    problems.extend(reg.conflicts)
+    return problems
+
+
+def lint_pinned(prefix: str, expected: set[str], what: str,
+                registry=None) -> list[str]:
+    """Pin a subsystem's registry: every expected series exists, and no
+    stray metric squats under the prefix unreviewed."""
+    reg = _registry(registry)
+    names = {m.name for m in reg.walk()}
+    problems = [
+        f"{name}: {what} metric missing from the registry"
+        for name in sorted(expected - names)
+    ]
+    problems += [
+        f"{name}: unreviewed metric under {prefix} (add it to "
+        "the pinned set in tools/analyze/passes/metrics.py)"
+        for name in sorted(n for n in names
+                           if n.startswith(prefix) and n not in expected)
+    ]
+    return problems
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    problems = (
+        lint_registry()
+        + lint_pinned(CACHE_GROUP_PREFIX, CACHE_GROUP_EXPECTED, "cache-group")
+        + lint_pinned(INGEST_PREFIX, INGEST_EXPECTED, "ingest")
+        + lint_pinned(QOS_PREFIX, QOS_EXPECTED, "qos")
+    )
+    return [Finding("", 0, "metric-registry", p) for p in problems]
+
+
+PASS = Pass(
+    name="metric-registry",
+    rules=("metric-registry",),
+    run=run,
+    doc="metric naming/help/conflict hygiene + pinned per-subsystem series",
+)

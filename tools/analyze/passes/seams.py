@@ -1,0 +1,164 @@
+"""Architecture-seam lints migrated onto the shared framework (ISSUE 7
+satellite): the three AST checks that grew ad hoc in tools/lint_metrics.py
+across PRs 3/5/6, now running over the pre-parsed file list with
+framework findings.  tools/lint_metrics.py remains a thin compatibility
+shim over these.
+
+* ``resilience-seam`` (PR 3): every ``create_storage`` consumer reaches
+  the backend through the resilience wrapper (``resilient(...)`` or via
+  ``CachedStore``/``build_store``).
+* ``ingest-seam`` (PR 5): ``WSlice._upload_block`` submissions flow
+  through the ingest stage when the store has one.
+* ``qos-seam`` (PR 6): no bare ``ThreadPoolExecutor`` outside ``qos/``
+  and the whitelisted resilience elastic pool.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Finding, Pass, SourceFile, call_name, parent_map
+
+# pools allowed to exist OUTSIDE the unified scheduler (paths relative
+# to the analysis root, i.e. the package dir):
+#   - qos/ itself (the scheduler's own workers);
+#   - object/resilient.py (the elastic abandonment pool: a hung attempt
+#     must be abandonable, which a shared bounded worker set cannot do).
+QOS_SEAM_WHITELIST = ("qos/", "object/resilient.py")
+
+
+def _pkg_rel(sf: SourceFile) -> str:
+    """Path relative to the analysis root (`rel` keeps the root's own
+    directory name as its first segment — strip it so the whitelist and
+    the object-layer skip work for any root, incl. test fixtures)."""
+    return sf.rel.split("/", 1)[1] if "/" in sf.rel else sf.rel
+
+
+def run_qos_seam(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.tree is None or "ThreadPoolExecutor" not in sf.text:
+            continue
+        rel = _pkg_rel(sf)
+        if any(rel.startswith(w) or rel == w for w in QOS_SEAM_WHITELIST):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) == "ThreadPoolExecutor":
+                findings.append(Finding(
+                    sf.rel, node.lineno, "qos-seam",
+                    "bare ThreadPoolExecutor outside qos/ — submit through "
+                    "the unified scheduler "
+                    "(qos.global_scheduler().executor(lane, cls))",
+                ))
+    return findings
+
+
+def run_resilience_seam(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.tree is None or "create_storage" not in sf.text:
+            continue
+        if _pkg_rel(sf).split("/", 1)[0] == "object":
+            continue  # the wrapper layer itself
+        # AST-level on both sides: bare-store detection AND coverage must
+        # be real CALLS — a docstring mentioning "CachedStore(" must not
+        # satisfy the check
+        called = {call_name(node) for node in ast.walk(sf.tree)
+                  if isinstance(node, ast.Call)}
+        if "create_storage" not in called:
+            continue
+        if not called & {"resilient", "CachedStore", "build_store"}:
+            findings.append(Finding(
+                sf.rel, 0, "resilience-seam",
+                "create_storage() result never passes through the "
+                "resilience wrapper (use resilient(...) or "
+                "CachedStore/build_store)",
+            ))
+    return findings
+
+
+def run_ingest_seam(files: list[SourceFile]) -> list[Finding]:
+    sf = next((s for s in files
+               if s.rel.endswith("chunk/cached_store.py")), None)
+    if sf is None or sf.tree is None:
+        # only the real package tree must contain the seam — fixture
+        # trees (unit tests, --root) simply have nothing to check
+        if any(s.rel.startswith("juicefs_tpu/") for s in files):
+            return [Finding("juicefs_tpu/chunk/cached_store.py", 0,
+                            "ingest-seam",
+                            "chunk/cached_store.py not found or unparseable")]
+        return []
+    return check_ingest_seam(sf)
+
+
+def check_ingest_seam(sf: SourceFile) -> list[Finding]:
+    """Inside `WSlice._upload_block`, every `_put_or_stage` submission
+    must sit under an `if` whose test references `ingest`, and the guard
+    must actually route somewhere (an ingest.submit call) — a refactor
+    reintroducing an unconditional direct upload silently disables
+    elision, which no functional test catches on a low-dup workload."""
+    fn = None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "WSlice":
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) \
+                        and item.name == "_upload_block":
+                    fn = item
+    if fn is None:
+        return [Finding(sf.rel, 0, "ingest-seam",
+                        "WSlice._upload_block not found")]
+    parents = parent_map(fn)
+
+    def guarded_by_ingest(node) -> bool:
+        cur = node
+        while id(cur) in parents:
+            cur = parents[id(cur)]
+            if isinstance(cur, ast.If) and any(
+                isinstance(n, (ast.Name, ast.Attribute))
+                and (getattr(n, "id", None) == "ingest"
+                     or getattr(n, "attr", None) == "ingest")
+                for n in ast.walk(cur.test)
+            ):
+                return True
+        return False
+
+    findings = [
+        Finding(sf.rel, node.lineno, "ingest-seam",
+                "WSlice._upload_block submits _put_or_stage outside an "
+                "`ingest` guard — block uploads must flow through the "
+                "ingest stage when the store has one")
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Attribute) and node.attr == "_put_or_stage"
+        and not guarded_by_ingest(node)
+    ]
+    has_submit = any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "submit"
+        and (getattr(node.func.value, "id", None) == "ingest"
+             or getattr(node.func.value, "attr", None) == "ingest")
+        for node in ast.walk(fn)
+    )
+    if not has_submit:
+        findings.append(Finding(
+            sf.rel, 0, "ingest-seam",
+            "WSlice._upload_block never calls ingest.submit(...) — the "
+            "inline-dedup seam is gone",
+        ))
+    return findings
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    return (run_qos_seam(files) + run_resilience_seam(files)
+            + run_ingest_seam(files))
+
+
+PASS = Pass(
+    name="seams",
+    rules=("qos-seam", "resilience-seam", "ingest-seam"),
+    run=run,
+    doc="architecture seams: scheduler-only pools, resilience-wrapped "
+        "stores, ingest-guarded uploads",
+)

@@ -1,0 +1,187 @@
+"""Daemon/shutdown lint (rules ``thread-daemon``, ``thread-shutdown``).
+
+Two invariants the thread-leak guard in tests/conftest.py enforces only
+dynamically (and only for non-daemon threads a test happens to leak):
+
+* ``thread-daemon`` — every ``threading.Thread(...)`` must pass
+  ``daemon=`` explicitly.  The default (inherit the creator's flag) is
+  exactly how a helper meant to die with the process ends up non-daemon
+  when constructed from a worker, and vice versa; the repo's convention
+  (ARCHITECTURE "Concurrency model" table) is that daemon-ness is a
+  per-thread design decision, written at the construction site.
+
+* ``thread-shutdown`` — a thread or executor a class starts and KEEPS
+  (``self.x = Thread(...)`` + ``self.x.start()``, or
+  ``self.x = <sched>.executor(...)``) must be reachable from a
+  ``close()/stop()/shutdown()/__exit__()`` path of that class: some
+  teardown method must reference the attribute (join it, signal it,
+  shut it down).  A kept-but-unstoppable worker is a leak the suite
+  only notices when it is non-daemon AND a test leaks it.
+
+Fire-and-forget local threads are fine when daemon=True (they die with
+the process by design) or when the creating function joins them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Finding, Pass, SourceFile, attr_chain, call_name
+
+_TEARDOWN_NAMES = ("close", "stop", "shutdown", "__exit__", "unmount",
+                   "disconnect", "terminate", "join", "cancel")
+
+
+def _is_teardown(name: str) -> bool:
+    """close/stop/shutdown and their variants (close_all, close_session,
+    _stop, ...) count as teardown paths."""
+    return name.lstrip("_").startswith(_TEARDOWN_NAMES) \
+        or name in _TEARDOWN_NAMES
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and call_name(node) == "Thread"
+
+
+def _is_executor_ctor(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "executor")
+
+
+def _daemon_kw(call: ast.Call) -> Optional[bool]:
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return True   # explicit but dynamic: the decision is written
+    return None
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        _check_file(sf, findings)
+    return findings
+
+
+def _check_file(sf: SourceFile, findings: list[Finding]) -> None:
+    # 1) every Thread(...) call carries an explicit daemon=
+    for node in ast.walk(sf.tree):
+        if _is_thread_ctor(node) and _daemon_kw(node) is None:
+            findings.append(Finding(
+                sf.rel, node.lineno, "thread-daemon",
+                "threading.Thread(...) without an explicit daemon= — "
+                "daemon-ness is inherited from the creating thread unless "
+                "written down, which flips when the construction site "
+                "moves onto a worker",
+            ))
+
+    # 2) kept threads/executors reachable from a teardown path
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        kept: dict[str, tuple[int, str, Optional[bool]]] = {}
+        started_attrs: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                chain = attr_chain(node.targets[0])
+                if chain and len(chain) == 2 and chain[0] == "self":
+                    if _is_thread_ctor(node.value):
+                        kept[chain[1]] = (node.lineno, "thread",
+                                          _daemon_kw(node.value))
+                    elif _is_executor_ctor(node.value):
+                        kept[chain[1]] = (node.lineno, "executor", None)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "start":
+                chain = attr_chain(node.func.value)
+                if chain and len(chain) == 2 and chain[0] == "self":
+                    started_attrs.add(chain[1])
+        if not kept:
+            continue
+        teardown_refs: set[str] = set()
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_teardown(item.name):
+                for node in ast.walk(item):
+                    chain = attr_chain(node) if isinstance(
+                        node, ast.Attribute) else None
+                    if chain and len(chain) >= 2 and chain[0] == "self":
+                        teardown_refs.add(chain[1])
+                    # teardown may drain via a helper: one hop through
+                    # self-calls keeps refactors honest without a closure
+                    if isinstance(node, ast.Call):
+                        cchain = attr_chain(node.func)
+                        if cchain and len(cchain) == 2 \
+                                and cchain[0] == "self":
+                            for sub in cls.body:
+                                if isinstance(sub, (ast.FunctionDef,
+                                                    ast.AsyncFunctionDef)) \
+                                        and sub.name == cchain[1]:
+                                    for n2 in ast.walk(sub):
+                                        c2 = attr_chain(n2) if isinstance(
+                                            n2, ast.Attribute) else None
+                                        if c2 and len(c2) >= 2 \
+                                                and c2[0] == "self":
+                                            teardown_refs.add(c2[1])
+        for attr, (line, kind, _daemon) in sorted(kept.items()):
+            if kind == "thread" and attr not in started_attrs:
+                continue   # constructed but never started here
+            if attr not in teardown_refs:
+                findings.append(Finding(
+                    sf.rel, line, "thread-shutdown",
+                    f"{cls.name}.{attr} ({kind}) is started/kept but no "
+                    f"{'/'.join(_TEARDOWN_NAMES[:3])} path of {cls.name} "
+                    "references it — it cannot be torn down",
+                ))
+
+    # 3) fire-and-forget locals: non-daemon local threads must be joined
+    #    in the same function (or stored on self, handled above)
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local_threads: dict[str, tuple[int, Optional[bool]]] = {}
+        joined: set[str] = set()
+        anon: list[tuple[int, Optional[bool]]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _is_thread_ctor(node.value):
+                local_threads[node.targets[0].id] = (
+                    node.lineno, _daemon_kw(node.value))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                if node.func.attr == "join" \
+                        and isinstance(node.func.value, ast.Name):
+                    joined.add(node.func.value.id)
+                elif node.func.attr == "start" \
+                        and _is_thread_ctor(node.func.value):
+                    anon.append((node.lineno, _daemon_kw(node.func.value)))
+        for name, (line, daemon) in sorted(local_threads.items()):
+            if daemon is False and name not in joined:
+                findings.append(Finding(
+                    sf.rel, line, "thread-shutdown",
+                    f"non-daemon local thread {name!r} is never joined in "
+                    "its creating function and not kept on self — nothing "
+                    "can stop it",
+                ))
+        for line, daemon in anon:
+            if daemon is False:
+                findings.append(Finding(
+                    sf.rel, line, "thread-shutdown",
+                    "anonymous non-daemon Thread(...).start(): no handle "
+                    "exists to join or stop it",
+                ))
+
+
+PASS = Pass(
+    name="threads",
+    rules=("thread-daemon", "thread-shutdown"),
+    run=run,
+    doc="explicit daemon= on every Thread; kept threads/executors "
+        "reachable from a close/stop/shutdown path",
+)

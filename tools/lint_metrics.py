@@ -1,16 +1,13 @@
-"""Metric registry lint (CI check, invoked from the test suite).
+"""Compatibility shim over the unified analysis framework (ISSUE 7).
 
-Imports every module that registers metrics at import time, then walks the
-global registry and fails on:
+The registry lint and the three seam checks that accreted here across
+PRs 1-6 now live in ``tools/analyze/`` (one shared AST walk, one
+findings model, one CLI).  This module keeps the historical ``lint*()``
+/ CLI contract so existing tests and CI invocations don't break; the
+duplicated AST-walking helpers are gone.
 
-  - names missing the `juicefs_` prefix (one namespace for every exporter);
-  - missing help strings (Grafana/`stats` render them);
-  - conflicting duplicate registrations (same name re-registered with a
-    different type or label set — the silent first-wins behavior would
-    otherwise swallow one of them).
-
-Run directly (`python tools/lint_metrics.py`, exit 1 on problems) or call
-`lint()` from a test.
+Run ``python -m tools.analyze`` for the full analysis (lock-order,
+blocking-under-lock, lane-graph, thread lints, seams, registry).
 """
 
 from __future__ import annotations
@@ -18,344 +15,64 @@ from __future__ import annotations
 import os
 import sys
 
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools.analyze.core import SourceFile, load_files  # noqa: E402
+from tools.analyze.passes import metrics as _metrics  # noqa: E402
+from tools.analyze.passes import seams as _seams  # noqa: E402
 
-def _populate_registry() -> None:
-    """Import the modules whose metrics register at import time, and the
-    runtime registrations that are cheap to trigger."""
-    import juicefs_tpu.cache.group          # noqa: F401  peer hit/miss/ring
-    import juicefs_tpu.cache.server         # noqa: F401  peer served counters
-    import juicefs_tpu.chunk.cached_store   # noqa: F401  staging gauges
-    import juicefs_tpu.chunk.disk_cache     # noqa: F401  disk tier counters
-    import juicefs_tpu.chunk.ingest         # noqa: F401  inline-dedup counters
-    import juicefs_tpu.chunk.mem_cache      # noqa: F401  cache hit/miss/evict
-    import juicefs_tpu.chunk.parallel       # noqa: F401  fetch_inflight gauge
-    import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
-    import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
-    import juicefs_tpu.metric.trace         # noqa: F401  stage rollup histogram
-    import juicefs_tpu.object.metered       # noqa: F401  per-backend op meters
-    import juicefs_tpu.object.resilient     # noqa: F401  retry/hedge/breaker
-    import juicefs_tpu.object.sharding      # noqa: F401  shard routing counter
-    import juicefs_tpu.qos.limiter          # noqa: F401  bandwidth throttling
-    import juicefs_tpu.qos.scheduler        # noqa: F401  scheduler classes
-    import juicefs_tpu.tpu.pipeline         # noqa: F401  batch metrics
-    from juicefs_tpu.metric import register_process_metrics
+# re-exported pinned sets (legacy import surface)
+CACHE_GROUP_PREFIX = _metrics.CACHE_GROUP_PREFIX
+CACHE_GROUP_EXPECTED = _metrics.CACHE_GROUP_EXPECTED
+INGEST_PREFIX = _metrics.INGEST_PREFIX
+INGEST_EXPECTED = _metrics.INGEST_EXPECTED
+QOS_PREFIX = _metrics.QOS_PREFIX
+QOS_EXPECTED = _metrics.QOS_EXPECTED
 
-    register_process_metrics()
+_PKG_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "juicefs_tpu"
+)
 
 
 def lint(registry=None) -> list[str]:
-    """Return a list of problems (empty = clean). With an explicit
+    """Registry hygiene problems (empty = clean).  With an explicit
     registry, lint it as-is; only the global registry needs the
     metric-registering modules imported first."""
-    from juicefs_tpu.metric import global_registry
-
-    if registry is None:
-        _populate_registry()
-    reg = registry or global_registry()
-    problems: list[str] = []
-    for m in reg.walk():
-        if not m.name.startswith("juicefs_"):
-            problems.append(f"{m.name}: metric name lacks the juicefs_ prefix")
-        if not m.help.strip():
-            problems.append(f"{m.name}: missing help string")
-        if m.kind not in ("counter", "gauge", "histogram"):
-            problems.append(f"{m.name}: unknown metric kind {m.kind!r}")
-    problems.extend(reg.conflicts)
-    return problems
-
-
-# the cache-group registry contract (ISSUE 4): the subsystem's metrics all
-# live under ONE prefix, and these series are load-bearing (tests and the
-# BENCHMARKS table counter-assert them) — a rename must fail CI, not
-# silently zero a dashboard
-CACHE_GROUP_PREFIX = "juicefs_cache_group_"
-CACHE_GROUP_EXPECTED = {
-    "juicefs_cache_group_peer_hits",
-    "juicefs_cache_group_peer_misses",
-    "juicefs_cache_group_peer_errors",
-    "juicefs_cache_group_ring_size",
-    "juicefs_cache_group_peer_get_seconds",
-    "juicefs_cache_group_served",
-    "juicefs_cache_group_served_bytes",
-    "juicefs_cache_group_serve_misses",
-}
+    return _metrics.lint_registry(registry)
 
 
 def lint_cache_group(registry=None) -> list[str]:
-    """Pin the juicefs_cache_group_* registry: every expected series
-    exists, and no stray metric squats under the prefix unreviewed."""
-    from juicefs_tpu.metric import global_registry
-
-    if registry is None:
-        _populate_registry()
-    reg = registry or global_registry()
-    names = {m.name for m in reg.walk()}
-    problems = [
-        f"{name}: cache-group metric missing from the registry"
-        for name in sorted(CACHE_GROUP_EXPECTED - names)
-    ]
-    problems += [
-        f"{name}: unreviewed metric under {CACHE_GROUP_PREFIX} (add it to "
-        "CACHE_GROUP_EXPECTED in tools/lint_metrics.py)"
-        for name in sorted(n for n in names
-                           if n.startswith(CACHE_GROUP_PREFIX)
-                           and n not in CACHE_GROUP_EXPECTED)
-    ]
-    return problems
-
-
-# the ingest registry contract (ISSUE 5): same pinned-set pattern as the
-# cache group — the bench and the dedup drills counter-assert these series,
-# so a rename must fail CI instead of silently zeroing an elision dashboard
-INGEST_PREFIX = "juicefs_ingest_"
-INGEST_EXPECTED = {
-    "juicefs_ingest_blocks",
-    "juicefs_ingest_bytes",
-    "juicefs_ingest_put_elided",
-    "juicefs_ingest_put_elided_bytes",
-    "juicefs_ingest_uploaded",
-    "juicefs_ingest_passthrough",
-    "juicefs_ingest_race_collapsed",
-    "juicefs_ingest_errors",
-    "juicefs_ingest_queue_blocks",
-}
+    return _metrics.lint_pinned(CACHE_GROUP_PREFIX, CACHE_GROUP_EXPECTED,
+                                "cache-group", registry)
 
 
 def lint_ingest(registry=None) -> list[str]:
-    """Pin the juicefs_ingest_* registry: every expected series exists,
-    and no stray metric squats under the prefix unreviewed."""
-    from juicefs_tpu.metric import global_registry
-
-    if registry is None:
-        _populate_registry()
-    reg = registry or global_registry()
-    names = {m.name for m in reg.walk()}
-    problems = [
-        f"{name}: ingest metric missing from the registry"
-        for name in sorted(INGEST_EXPECTED - names)
-    ]
-    problems += [
-        f"{name}: unreviewed metric under {INGEST_PREFIX} (add it to "
-        "INGEST_EXPECTED in tools/lint_metrics.py)"
-        for name in sorted(n for n in names
-                           if n.startswith(INGEST_PREFIX)
-                           and n not in INGEST_EXPECTED)
-    ]
-    return problems
-
-
-def lint_ingest_seam(path: str | None = None) -> list[str]:
-    """No-bare-upload check (ISSUE 5): WSlice block uploads must flow
-    through the ingest stage when the store has one. Concretely: inside
-    `WSlice._upload_block`, every `_put_or_stage` submission must sit
-    under an `if` whose test references `ingest` — a refactor that
-    reintroduces an unconditional direct upload would silently disable
-    elision (writes still succeed, dedup just stops happening), which no
-    functional test catches on a low-dup workload."""
-    import ast
-
-    path = path or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "juicefs_tpu", "chunk", "cached_store.py",
-    )
-    with open(path) as f:
-        tree = ast.parse(f.read())
-    fn = None
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "WSlice":
-            for item in node.body:
-                if isinstance(item, ast.FunctionDef) \
-                        and item.name == "_upload_block":
-                    fn = item
-    if fn is None:
-        return ["WSlice._upload_block not found in chunk/cached_store.py"]
-
-    parents: dict[int, ast.AST] = {}
-    for node in ast.walk(fn):
-        for child in ast.iter_child_nodes(node):
-            parents[id(child)] = node
-
-    def guarded_by_ingest(node) -> bool:
-        cur = node
-        while id(cur) in parents:
-            cur = parents[id(cur)]
-            if isinstance(cur, ast.If) and any(
-                isinstance(n, (ast.Name, ast.Attribute))
-                and (getattr(n, "id", None) == "ingest"
-                     or getattr(n, "attr", None) == "ingest")
-                for n in ast.walk(cur.test)
-            ):
-                return True
-        return False
-
-    problems = []
-    bare = [
-        node for node in ast.walk(fn)
-        if isinstance(node, ast.Attribute) and node.attr == "_put_or_stage"
-        and not guarded_by_ingest(node)
-    ]
-    for node in bare:
-        problems.append(
-            f"chunk/cached_store.py:{node.lineno}: WSlice._upload_block "
-            "submits _put_or_stage outside an `ingest` guard — block "
-            "uploads must flow through the ingest stage when the store "
-            "has one"
-        )
-    # the guard must actually route somewhere: an ingest.submit call
-    has_submit = any(
-        isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Attribute)
-        and node.func.attr == "submit"
-        and isinstance(node.func.value, (ast.Name, ast.Attribute))
-        and (getattr(node.func.value, "id", None) == "ingest"
-             or getattr(node.func.value, "attr", None) == "ingest")
-        for node in ast.walk(fn)
-    )
-    if not has_submit:
-        problems.append(
-            "chunk/cached_store.py: WSlice._upload_block never calls "
-            "ingest.submit(...) — the inline-dedup seam is gone"
-        )
-    return problems
-
-
-# the QoS registry contract (ISSUE 6): the unified scheduler/limiter
-# series the chaos drill and the BENCH_r07 mixed-workload bench
-# counter-assert — a rename must fail CI, not silently zero a dashboard
-QOS_PREFIX = "juicefs_qos_"
-QOS_EXPECTED = {
-    "juicefs_qos_submitted",
-    "juicefs_qos_completed",
-    "juicefs_qos_shed",
-    "juicefs_qos_wait_seconds",
-    "juicefs_qos_queue_depth",
-    "juicefs_qos_throttle_wait_seconds",
-    "juicefs_qos_throttled_bytes",
-}
+    return _metrics.lint_pinned(INGEST_PREFIX, INGEST_EXPECTED,
+                                "ingest", registry)
 
 
 def lint_qos(registry=None) -> list[str]:
-    """Pin the juicefs_qos_* registry: every expected series exists, and
-    no stray metric squats under the prefix unreviewed."""
-    from juicefs_tpu.metric import global_registry
-
-    if registry is None:
-        _populate_registry()
-    reg = registry or global_registry()
-    names = {m.name for m in reg.walk()}
-    problems = [
-        f"{name}: qos metric missing from the registry"
-        for name in sorted(QOS_EXPECTED - names)
-    ]
-    problems += [
-        f"{name}: unreviewed metric under {QOS_PREFIX} (add it to "
-        "QOS_EXPECTED in tools/lint_metrics.py)"
-        for name in sorted(n for n in names
-                           if n.startswith(QOS_PREFIX)
-                           and n not in QOS_EXPECTED)
-    ]
-    return problems
+    return _metrics.lint_pinned(QOS_PREFIX, QOS_EXPECTED, "qos", registry)
 
 
-# pools allowed to exist OUTSIDE the unified scheduler:
-#   - qos/ itself (the scheduler's own workers);
-#   - object/resilient.py (the elastic abandonment pool: a hung attempt
-#     must be abandonable, which a shared bounded worker set cannot do —
-#     the ISSUE 6 whitelisted resilience pool).
-_QOS_SEAM_WHITELIST = ("qos" + os.sep, os.path.join("object", "resilient.py"))
+def lint_ingest_seam(path: str | None = None) -> list[str]:
+    """No-bare-upload check (ISSUE 5), framework-backed."""
+    path = path or os.path.join(_PKG_ROOT, "chunk", "cached_store.py")
+    with open(path) as f:
+        sf = SourceFile(path, path, f.read())
+    return [f.render() for f in _seams.check_ingest_seam(sf)]
 
 
 def lint_qos_seam(root: str | None = None) -> list[str]:
-    """No-bare-pool check (ISSUE 6): every concurrency seam in the
-    package must ride the unified scheduler.  A module that spins up its
-    own ThreadPoolExecutor bypasses priority classes, tenant fairness,
-    shedding and the bandwidth budget — exactly the mutually-blind pool
-    sprawl the scheduler replaced, and nothing functional would catch the
-    regression (the work still completes, QoS just silently stops
-    applying to it)."""
-    import ast
-
-    root = root or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "juicefs_tpu",
-    )
-    problems: list[str] = []
-    for dirpath, _dirs, files in os.walk(root):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, root)
-            if any(rel.startswith(w) or rel == w
-                   for w in _QOS_SEAM_WHITELIST):
-                continue
-            with open(path) as f:
-                src = f.read()
-            if "ThreadPoolExecutor" not in src:
-                continue
-            for node in ast.walk(ast.parse(src)):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = (getattr(node.func, "id", None)
-                        or getattr(node.func, "attr", None))
-                if name == "ThreadPoolExecutor":
-                    problems.append(
-                        f"juicefs_tpu/{rel}:{node.lineno}: bare "
-                        "ThreadPoolExecutor outside qos/ — submit through "
-                        "the unified scheduler "
-                        "(qos.global_scheduler().executor(lane, cls))"
-                    )
-    return problems
+    """No-bare-pool check (ISSUE 6), framework-backed."""
+    files = load_files(root or _PKG_ROOT)
+    return [f.render() for f in _seams.run_qos_seam(files)]
 
 
 def lint_resilience(root: str | None = None) -> list[str]:
-    """Sibling check (ISSUE 3): every `create_storage` consumer inside the
-    package must reach the backend through the resilience wrapper — either
-    it wraps the store itself (`resilient(...)`) or it hands the store to
-    `CachedStore`/`build_store`, which wrap internally.  A module that
-    opens a bare store and talks to the backend directly has no deadline,
-    no classified retries, and no breaker — exactly the improvised fault
-    handling this layer replaced."""
-    import ast
-
-    root = root or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "juicefs_tpu",
-    )
-    problems: list[str] = []
-    for dirpath, _dirs, files in os.walk(root):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, root)
-            if rel.split(os.sep, 1)[0] == "object":
-                continue  # the wrapper layer itself
-            with open(path) as f:
-                src = f.read()
-            if "create_storage" not in src:
-                continue
-            # AST-level on both sides: bare-store detection AND coverage
-            # must be real CALLS — a docstring or comment mentioning
-            # "CachedStore(" must not satisfy the check
-            called = {
-                getattr(node.func, "id", None) or getattr(node.func, "attr", None)
-                for node in ast.walk(ast.parse(src))
-                if isinstance(node, ast.Call)
-            }
-            if "create_storage" not in called:
-                continue
-            covered = called & {"resilient", "CachedStore", "build_store"}
-            if not covered:
-                problems.append(
-                    f"juicefs_tpu/{rel}: create_storage() result never "
-                    "passes through the resilience wrapper (use "
-                    "resilient(...) or CachedStore/build_store)"
-                )
-    return problems
+    """No-bare-store check (ISSUE 3), framework-backed."""
+    files = load_files(root or _PKG_ROOT)
+    return [f.render() for f in _seams.run_resilience_seam(files)]
 
 
 def main() -> int:

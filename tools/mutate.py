@@ -64,6 +64,22 @@ TEST_MAP = {
     "juicefs_tpu/tpu/jth256": ["tests/test_tpu_hash.py"],
     "juicefs_tpu/qos/scheduler": ["tests/test_qos.py"],
     "juicefs_tpu/qos/limiter": ["tests/test_qos.py"],
+    # ISSUE 7: the concurrency-contract analyzer and its runtime twin.
+    # Fast subset: the seeded-violation fixtures + real-tree gates kill
+    # logic mutants without the subprocess CLI round-trips ("-k" args
+    # ride the pytest argv); the watchdog drills kill lockwatch mutants.
+    "tools/analyze/core": ["tests/test_analysis.py", "-k", "not cli"],
+    "tools/analyze/passes/locks": ["tests/test_analysis.py", "-k", "not cli"],
+    "tools/analyze/passes/lock_order": ["tests/test_analysis.py",
+                                        "-k", "not cli"],
+    "tools/analyze/passes/blocking": ["tests/test_analysis.py",
+                                      "-k", "not cli"],
+    "tools/analyze/passes/lane_graph": ["tests/test_analysis.py",
+                                        "-k", "not cli"],
+    "tools/analyze/passes/threads": ["tests/test_analysis.py",
+                                     "-k", "not cli"],
+    "juicefs_tpu/utils/lockwatch": ["tests/test_analysis.py",
+                                    "-k", "watchdog"],
 }
 DEFAULT_TESTS = ["tests/test_meta.py", "tests/test_vfs.py"]
 
