@@ -5,6 +5,7 @@ individual objects, with compression, a local disk/memory cache, writeback
 staging, singleflight load dedup, and prefetching.
 """
 
+from .bypass import ElisionGovernor  # noqa: F401
 from .cached_store import CachedStore, ChunkConfig, block_key, parse_block_key  # noqa: F401
 from .ingest import ContentRefs, IngestPipeline  # noqa: F401
 from .singleflight import SingleFlight  # noqa: F401

@@ -36,6 +36,7 @@ from ..object.resilient import (
     resilient,
 )
 from ..qos import IOClass, Limiter, gated, global_scheduler, shaped
+from ..tpu.compress_batch import CompressBatchConfig, CompressPlane
 from ..utils import get_logger
 from .disk_cache import CacheManager, DiskCache
 from .mem_cache import MemCache
@@ -167,6 +168,13 @@ class ChunkConfig:
     download_limit: float = 0.0
     limiter: Optional["Limiter"] = None
     scheduler: Optional[object] = None
+    # batched compression plane (ISSUE 8): backend registry cpu|xla and
+    # encode-lane width on the qos slice lane (0 = host cores)
+    compress_backend: str = "cpu"
+    compress_lanes: int = 0
+    # adaptive elision bypass (chunk/bypass.py) on --inline-dedup mounts:
+    # sample the live dup density and skip hash+lookup when it is low
+    dedup_bypass: bool = True
 
 
 class TornDataError(IOError):
@@ -223,6 +231,15 @@ class CachedStore:
         # them without stopping workers other stores share.
         sched = self.conf.scheduler or global_scheduler()
         self.scheduler = sched
+        # batched compression plane (ISSUE 8): the write path's only
+        # compress seam — `_put_block` encodes through it, the ingest
+        # finalizer feeds it whole MISS batches (slice-lane fan-out)
+        self.compress_plane = CompressPlane(
+            self.compressor,
+            CompressBatchConfig(backend=self.conf.compress_backend,
+                                lanes=self.conf.compress_lanes),
+            scheduler=sched,
+        )
         self._pool = sched.executor(
             "upload", IOClass.FOREGROUND, width=self.conf.max_upload)
         # ingest-stage canonical PUTs (chunk/ingest.py leader uploads)
@@ -292,23 +309,27 @@ class CachedStore:
         return bool(getattr(self.storage, "degraded", False))
 
     def _put_block(self, key: str, raw: bytes, parent=None,
-                   fingerprint: bool = True) -> None:
+                   fingerprint: bool = True,
+                   data: Optional[bytes] = None) -> None:
         """Compress (+fingerprint) and PUT one block
         (reference cached_store.go:371-413 upload). `parent` is the span
         ref captured before the upload-pool crossing. The ingest stage
         passes fingerprint=False — it already hashed the block and wrote
-        the index row itself."""
+        the index row itself — and may carry `data`, the pre-compressed
+        bytes from the finalizer's batched compress stage (ISSUE 8), so
+        the PUT worker ships immediately instead of encoding inline."""
         with _TR.span("chunk", "upload", stage="put", hist=_H_UPLOAD,
                       parent=parent) as sp:
             if sp.active:
                 sp.set(key=key, bytes=len(raw))
             if fingerprint and self.conf.fingerprint is not None:
                 self.conf.fingerprint(key, raw)
-            with _TR.span("chunk", "upload", stage="compress",
-                          hist=_H_COMPRESS) as csp:
-                if csp.active:
-                    csp.set(key=key, bytes=len(raw))
-                data = self.compressor.compress(raw)
+            if data is None:
+                with _TR.span("chunk", "upload", stage="compress",
+                              hist=_H_COMPRESS) as csp:
+                    if csp.active:
+                        csp.set(key=key, bytes=len(raw))
+                    data = self.compress_plane.compress_one(raw)
             self.storage.put(key, data)
 
     def _note_cache_hit(self, key: str, bsize: int) -> None:
@@ -565,6 +586,7 @@ class CachedStore:
         self._fetcher.close()  # stop issuing new loads before teardown
         self._rpool.shutdown(wait=True, cancel_futures=True)
         self._bulk_pool.shutdown(wait=True, cancel_futures=True)
+        self.compress_plane.close()
         if self.indexer is not None:
             try:
                 self.indexer.close()
@@ -731,9 +753,23 @@ class WSlice:
         self._closed = False
 
     def write_at(self, data: bytes, off: int) -> int:
-        """Copy into per-block page buffers (reference cached_store.go:282-325)."""
+        """Copy into per-block page buffers (reference cached_store.go:282-325).
+
+        Zero-copy fast path (ISSUE 8): a block-aligned write of exactly
+        one full block from an immutable bytes object is ALIASED, not
+        copied — on a 4 MiB block that memcpy costs as much CPU as the
+        hash, and bytes can never be mutated under us. A later partial
+        overwrite of the same block falls back by converting to a
+        bytearray."""
         if self._closed:
             raise IOError("write after finish/abort")
+        if (isinstance(data, bytes) and len(data) == self.bs
+                and off % self.bs == 0):
+            indx = off // self.bs
+            if indx not in self._blocks and indx not in self._uploaded:
+                self._blocks[indx] = data
+                self._length = max(self._length, off + self.bs)
+                return self.bs
         pos = off
         mv = memoryview(data)
         while mv:
@@ -744,6 +780,11 @@ class WSlice:
             buf = self._blocks.get(indx)
             if buf is None:
                 buf = bytearray()
+                self._blocks[indx] = buf
+            elif isinstance(buf, bytes):
+                # partial overwrite of a zero-copy aliased block: it
+                # needs mutability now, so pay the copy here
+                buf = bytearray(buf)
                 self._blocks[indx] = buf
             n = min(len(mv), self.bs - boff)
             if boff == len(buf):
@@ -766,8 +807,9 @@ class WSlice:
                 self._upload_block(indx, self.bs)
 
     def _upload_block(self, indx: int, bsize: int) -> None:
-        # keep the bytearray: a bytes() copy of every 4 MiB block would
-        # cost real bandwidth, and nothing mutates it after the pop
+        # keep the bytearray (or zero-copy aliased bytes): a bytes() copy
+        # of every 4 MiB block would cost real bandwidth, and nothing
+        # mutates it after the pop
         raw = self._blocks.pop(indx)
         if len(raw) < bsize:
             # pad from the shared zero source (no fresh multi-MiB zeros

@@ -42,6 +42,7 @@ from ..metric import global_registry
 from ..metric.trace import global_tracer, stage_hist
 from ..object.resilient import BreakerOpenError
 from ..utils import get_logger
+from .bypass import ElisionGovernor
 from .cached_store import block_key, parse_block_key
 
 logger = get_logger("chunk.ingest")
@@ -50,6 +51,10 @@ _TR = global_tracer()
 _H_HASH = stage_hist("chunk", "ingest", "hash")
 _H_LOOKUP = stage_hist("chunk", "ingest", "lookup")
 _H_REGISTER = stage_hist("chunk", "ingest", "register")
+# the finalizer-side batched encode reports under the same stage as the
+# per-block compress in `_put_block`: either way it is write-path
+# compression wall (bench stage breakdowns compare across rounds)
+_H_COMPRESS = stage_hist("chunk", "upload", "compress")
 
 _reg = global_registry()
 _BLOCKS = _reg.counter(
@@ -103,6 +108,21 @@ _reg.gauge(
 ).set_function(_queued_blocks)
 
 
+def _settle_future(fut: Future, exc=None) -> None:
+    """Resolve a block future exactly once. With early ack (ISSUE 8) a
+    leader future can be resolved from the PUT done-callback while a
+    finalizer/worker error path is still iterating the batch — losing
+    that race must be a no-op, not an InvalidStateError that kills the
+    thread."""
+    try:
+        if exc is None:
+            fut.set_result(None)
+        else:
+            fut.set_exception(exc)
+    except Exception:
+        pass  # already resolved by the racing path: first writer wins
+
+
 def alias_map(meta) -> dict[str, str]:
     """Snapshot {alias block key -> canonical block key} for offline
     consumers (gc leaked/missing diff, fsck existence checks): an elided
@@ -119,6 +139,110 @@ def alias_map(meta) -> dict[str, str]:
         if canonical is not None and canonical != key:
             out[key] = canonical
     return out
+
+
+class HotContentCache:
+    """LRU of recently seen block CONTENT -> digest (ISSUE 8).
+
+    Duplicate-heavy streams re-present the same few hot blocks
+    (dataloader epochs, VM images, build trees). Proving identity by
+    sampled fingerprint + full memcmp against the pinned copy costs
+    ~10x less than re-hashing 4 MiB through JTH-256, and stays EXACT:
+    byte equality implies digest equality, so an elision through the
+    cache is indistinguishable from one through a fresh hash. A sampled
+    fingerprint can collide (same head/tail/len, different middle), so
+    the memcmp is the authority — a mismatch is just a miss.
+
+    Doubles as the bypass governor's density probe (chunk/bypass.py):
+    `probe()` is called from writer threads for shadow samples, so the
+    map is lock-protected; probe misses park a DIGESTLESS entry
+    (fp -> (None, raw)) — a recurrence of never-hashed content still
+    registers as a density hit, which is what re-engages dedup after a
+    long bypass."""
+
+    def __init__(self, cap_bytes: int = 64 << 20):
+        from collections import OrderedDict
+
+        self._cap = max(1, cap_bytes)
+        self._map: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _fp(raw) -> bytes:
+        from .. import native
+
+        n = len(raw)
+        if n <= 16384:
+            sample = bytes(raw)
+        else:
+            sample = bytes(raw[:8192]) + bytes(raw[-8192:])
+        return native.jth256(sample + n.to_bytes(8, "little"))
+
+    def _match(self, fp: bytes, raw, need_digest: bool):
+        """Entry tuple iff the cached bytes equal `raw`. The multi-MiB
+        memcmp runs OUTSIDE the lock (entries are immutable tuples;
+        callers re-validate identity under the lock before mutating),
+        so concurrent writer-thread probes and the batch worker never
+        convoy behind each other's compares."""
+        with self._lock:
+            ent = self._map.get(fp)
+        if (ent is None or (need_digest and ent[0] is None)
+                or len(ent[1]) != len(raw)):
+            return None
+        return ent if ent[1] == raw else None
+
+    def lookup(self, raw):
+        """(digest or None, fp). The fp is returned so a following
+        insert() after the full hash needn't recompute it. An entry
+        whose bytes match but whose digest is None (parked by a probe)
+        counts as a miss here — the caller hashes and insert() upgrades
+        it."""
+        fp = self._fp(raw)
+        ent = self._match(fp, raw, need_digest=True)
+        with self._lock:
+            if ent is not None and self._map.get(fp) is ent:
+                self._map.move_to_end(fp)
+                self.hits += 1
+                return ent[0], fp
+            self.misses += 1
+            return None, fp
+
+    def probe(self, raw) -> bool:
+        """Density shadow-sample (bypass governor): True iff these bytes
+        match a cached entry — digest or not, recurrence is the signal.
+        A miss parks a digestless entry so future recurrences hit."""
+        fp = self._fp(raw)
+        ent = self._match(fp, raw, need_digest=False)
+        with self._lock:
+            if ent is not None and self._map.get(fp) is ent:
+                self._map.move_to_end(fp)
+                self.hits += 1
+                return True
+            self.misses += 1
+            self._insert_locked(fp, None, raw)
+            return False
+
+    def insert(self, fp: bytes, digest: bytes, raw) -> None:
+        with self._lock:
+            self._insert_locked(fp, digest, raw)
+
+    def _insert_locked(self, fp: bytes, digest, raw) -> None:
+        old = self._map.pop(fp, None)
+        if old is not None:
+            self._bytes -= len(old[1])
+        self._map[fp] = (digest, raw)
+        self._bytes += len(raw)
+        while self._bytes > self._cap and self._map:
+            _fp, (_d, r) = self._map.popitem(last=False)
+            self._bytes -= len(r)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._map), "bytes": self._bytes,
+                    "hits": self.hits, "misses": self.misses}
 
 
 class ContentRefs:
@@ -187,12 +311,22 @@ class IngestPipeline:
         batch_blocks: int = 32,
         queue_blocks: int = 64,
         flush_timeout: float = 0.005,
+        bypass: bool = True,
+        governor: Optional[ElisionGovernor] = None,
+        hot_bytes: int = 64 << 20,
     ):
         from ..tpu.pipeline import HashBatcher, HashPipeline, PipelineConfig
 
         self.store = store
         self.refs = refs
         self.backend = backend
+        # adaptive elision bypass (ISSUE 8): skip hash+lookup entirely
+        # while the sampled dup density stays below the low-water mark
+        self.governor = governor if governor is not None else (
+            ElisionGovernor() if bypass else None)
+        # hot-content digest cache (ISSUE 8): memcmp beats re-hashing
+        # for the duplicate-heavy streams dedup exists for (0 disables)
+        self._hot = HotContentCache(hot_bytes) if hot_bytes > 0 else None
         self._batcher = HashBatcher(
             HashPipeline(
                 PipelineConfig(
@@ -214,6 +348,17 @@ class IngestPipeline:
         import queue as _queue
 
         self._finalq: "_queue.Queue" = _queue.Queue()
+        self._empty = _queue.Empty
+        # register batches still queued/served by the finalizer: leaders
+        # ack at PUT (early ack), so flush() must separately drain this
+        # before promising "every submitted block is fully processed" —
+        # a dedup lookup right after flush must see the registrations
+        self._final_pending = 0
+        # digests whose canonical PUT/register is in flight (early ack
+        # means "registered" lags "durable"): a later batch holding the
+        # same content waits for the event instead of racing the
+        # register — its MISS becomes a clean HIT
+        self._inflight_reg: dict = {}
         # stats mirror of the global counters, per pipeline (bench/tests)
         self.blocks = 0
         self.elided = 0
@@ -245,6 +390,21 @@ class IngestPipeline:
         _BLOCKS.inc()
         _BYTES.inc(len(raw))
         self.blocks += 1
+        gov = self.governor
+        if not closed and gov is not None:
+            verdict = gov.admit()
+            if verdict == ElisionGovernor.PROBE and self._hot is not None:
+                # free density probe: sampled-fp + memcmp on the writer
+                # thread (~µs), upload proceeds untouched below
+                gov.record(self._hot.probe(raw))
+            elif verdict == ElisionGovernor.PROBE:
+                verdict = ElisionGovernor.DEDUP  # no hot cache: real probe
+            if verdict != ElisionGovernor.DEDUP:
+                # bypass: sampled dup density is low — this block skips
+                # hash/lookup and rides the plain FOREGROUND upload
+                # pool, exactly the no-dedup write path (counted by the
+                # governor, not as a degrade)
+                return self._passthrough(key, raw, parent, fut, count=False)
         if closed or not self._batcher.submit((key, raw, parent, fut, parsed)):
             # hash plane saturated (or a racing close()): the write must
             # not wait for dedup — and an item enqueued behind the CLOSE
@@ -281,8 +441,10 @@ class IngestPipeline:
             pool_fut = (pool or self.store._pool).submit(
                 self.store._put_or_stage, key, raw, parent
             )
-        except RuntimeError as e:  # pool shut down mid-teardown
-            fut.set_exception(e)
+        except (RuntimeError, TimeoutError) as e:
+            # pool shut down mid-teardown, or qos backpressure timed out:
+            # the block's fate must reach the caller, not kill the worker
+            _settle_future(fut, e)
             return fut
 
         def chain(pf, fut=fut):
@@ -311,22 +473,67 @@ class IngestPipeline:
                                           pool=self.store._ingest_pool)
 
     def _process(self, batch: list) -> None:
-        with _TR.span("chunk", "ingest", stage="hash", hist=_H_HASH) as sp:
-            if sp.active:
-                sp.set(blocks=len(batch), backend=self.backend)
-            digests = self._batcher.pipe.hash_blocks(
-                [raw for _, raw, _, _, _ in batch]
-            )
-        # keep the advisory content index complete for gc/fsck: elided
-        # blocks never reach the _put_block fingerprint hook, and we hold
-        # every digest right here — one batched meta txn
+        pipe = self._batcher.pipe
+        plane = getattr(self.store, "compress_plane", None)
+        # hot-content cache: blocks whose bytes match a recently seen
+        # block (sampled fp + full memcmp) take its digest without
+        # re-hashing; only the remainder goes through the hash plane
+        hot = self._hot
+        digests: list = [None] * len(batch)
+        fps: list = [None] * len(batch)
+        unknown = list(range(len(batch)))
+        if hot is not None:
+            unknown = []
+            for i, (_k, raw, _p, _f, _parsed) in enumerate(batch):
+                d, fp = hot.lookup(raw)
+                digests[i], fps[i] = d, fp
+                if d is None:
+                    unknown.append(i)
+        raws = [batch[i][1] for i in unknown]
+        packed = None
+        if raws and pipe.device_backend:
+            # shared H2D (ISSUE 8): ONE pack_blocks upload feeds the hash
+            # digests AND the compress plane's device estimator. The
+            # device_put is what makes the sharing real — passing host
+            # numpy arrays to two separate jitted fns would transfer the
+            # batch twice.
+            from ..tpu.jth256 import pack_blocks
+
+            packed = pack_blocks(raws, pad_lanes=pipe.config.pad_lanes)
+            try:
+                import jax
+
+                packed = tuple(jax.device_put(a) for a in packed)
+            except Exception:
+                pass  # host arrays still work, just without the sharing
+        if raws:
+            with _TR.span("chunk", "ingest", stage="hash",
+                          hist=_H_HASH) as sp:
+                if sp.active:
+                    sp.set(blocks=len(raws), backend=self.backend,
+                           hot_hits=len(batch) - len(raws))
+                if packed is not None:
+                    hashed = pipe.hash_packed(*packed)
+                else:
+                    hashed = pipe.hash_blocks(raws)
+            for j, i in enumerate(unknown):
+                digests[i] = hashed[j]
+                if hot is not None:
+                    hot.insert(fps[i], hashed[j], batch[i][1])
+        if packed is not None and plane is not None:
+            plane.estimate_packed(packed)  # advisory; rides the upload
+        self._await_inflight(digests)
+        # advisory content-index rows for gc/fsck: elided blocks never
+        # reach the _put_block fingerprint hook, and we hold every digest
+        # right here. Written by the FINALIZER (one batched txn off the
+        # worker critical path — a meta txn on this thread would stall
+        # the next batch's hash behind the GIL/meta convoy, ISSUE 8)
+        index_rows = None
         if getattr(self.refs.meta, "set_block_digests", None) is not None:
-            self.refs.meta.set_block_digests(
-                [
-                    (sid, indx, bsize, digests[i])
-                    for i, (_, _, _, _, (sid, indx, bsize)) in enumerate(batch)
-                ]
-            )
+            index_rows = [
+                (sid, indx, bsize, digests[i])
+                for i, (_, _, _, _, (sid, indx, bsize)) in enumerate(batch)
+            ]
 
         # one lookup txn for the whole batch; same-digest groups resolve
         # together (all hit, or all miss with one leader upload)
@@ -341,6 +548,7 @@ class IngestPipeline:
             )
 
         groups: dict[bytes, list] = {}
+        gov = self.governor
         for i, item in enumerate(batch):
             key, raw, parent, fut, parsed = item
             if results[i] is not None:
@@ -350,12 +558,69 @@ class IngestPipeline:
                 _ELIDED_BYTES.inc(len(raw))
                 self.elided += 1
                 self.elided_bytes += len(raw)
+                if gov is not None:
+                    gov.record(True)
                 fut.set_result(None)
             else:
-                groups.setdefault(digests[i], []).append(item)
+                members = groups.setdefault(digests[i], [])
+                if gov is not None:
+                    # a same-batch follower IS a duplicate for density
+                    # purposes, even though its elision lands at register
+                    gov.record(bool(members))
+                members.append(item)
 
+        # batched compress of the MISS leaders (ISSUE 8 tentpole): one
+        # slice-lane fan-out per batch instead of a serial encode inside
+        # each PUT worker; the PUTs below then ship pre-compressed bytes
+        # back-to-back (pipelined with the NEXT batch's hashing)
+        datas = None
+        if groups and plane is not None:
+            leaders = [members[0] for members in groups.values()]
+            try:
+                with _TR.span("chunk", "upload", stage="compress",
+                              hist=_H_COMPRESS) as sp:
+                    if sp.active:
+                        sp.set(blocks=len(leaders),
+                               backend=plane.backend)
+                    datas = plane.compress_blocks([m[1] for m in leaders])
+            except Exception as e:
+                # advisory: a broken plane degrades this batch to the
+                # per-block encode inside _put_block (byte-identical)
+                logger.warning("batch compress degraded: %s", e)
+                datas = None
+
+        # claim the finalizer work BEFORE any PUT is submitted: fast
+        # PUTs early-ack their futures, and a flush() polling between
+        # those acks and a late _final_pending increment would otherwise
+        # report drained with the index/register txns never queued
+        claimed = bool(groups or index_rows)
+        if claimed:
+            with self._lock:
+                self._final_pending += 1
         jobs = []
-        for digest, members in groups.items():
+        try:
+            jobs = self._submit_groups(groups, datas)
+        except BaseException:
+            # a submit blew past the per-group handling (e.g. qos
+            # backpressure TimeoutError): release the finalizer claim or
+            # flush()/close() would wait on it forever, then let _loop
+            # degrade the unresolved futures to passthrough
+            if claimed:
+                with self._lock:
+                    self._final_pending -= 1
+            raise
+        if jobs or index_rows:
+            with self._lock:
+                for digest, _m, _pf in jobs:
+                    self._inflight_reg.setdefault(digest, threading.Event())
+            self._finalq.put((index_rows, jobs))
+        elif claimed:
+            with self._lock:  # every submit bounced: nothing to finalize
+                self._final_pending -= 1
+
+    def _submit_groups(self, groups: dict, datas) -> list:
+        jobs = []
+        for gi, (digest, members) in enumerate(groups.items()):
             leader = members[0]
             try:
                 # INGEST class (ISSUE 6): canonical PUTs rank below
@@ -363,31 +628,91 @@ class IngestPipeline:
                 pf = self.store._ingest_pool.submit(
                     self.store._put_block, leader[0], leader[1], leader[2],
                     False,  # fingerprint=False: digest already recorded
+                    datas[gi] if datas is not None else None,
                 )
-            except RuntimeError as e:
+            except (RuntimeError, TimeoutError) as e:
                 for m in members:
-                    m[3].set_exception(e)
+                    _settle_future(m[3], e)
                 continue
+            # early ack (ISSUE 8 pipelining): the leader is durable the
+            # moment its own PUT lands — ack from the PUT completion
+            # itself, NOT from the finalizer (whose queue may be parked
+            # inside an earlier batch's register txn). Registration only
+            # affects later elidability; PUT-without-register is an
+            # existing crash window gc --dedup backfills.
+            pf.add_done_callback(
+                lambda f, fut=leader[3]: (
+                    _settle_future(fut)
+                    if f.exception() is None else None
+                )
+            )
             jobs.append((digest, members, pf))
-        if jobs:
-            self._finalq.put(jobs)
+        return jobs
+
+    def _await_inflight(self, digests: list) -> None:
+        """Block (bounded) on any digest whose register is in flight from
+        an earlier batch. Without this, early-acked content re-uploads on
+        the next batch and collapses at register — correct but wasted
+        PUTs; with it, sequential same-content writes elide exactly as
+        they did when the commit barrier covered the register txn. A
+        wedged finalizer only degrades back to the race-collapse path."""
+        evs = []
+        with self._lock:
+            for d in dict.fromkeys(digests):
+                ev = self._inflight_reg.get(d)
+                if ev is not None:
+                    evs.append(ev)
+        for ev in evs:
+            ev.wait(10.0)
+
+    def _settle_inflight(self, digests: list) -> None:
+        with self._lock:
+            for d in digests:
+                ev = self._inflight_reg.pop(d, None)
+                if ev is not None:
+                    ev.set()
 
     def _finalize_loop(self) -> None:
         """Wait each batch's canonical PUTs, then commit ONE register txn
         for the new content and ONE incref txn for same-batch followers —
         amortizing meta commits over the batch while batch k+1 hashes."""
         while True:
-            jobs = self._finalq.get()
-            if jobs is None:
+            item = self._finalq.get()
+            if item is None:
                 return
+            # coalesce everything already queued: under load the
+            # finalizer self-batches, so ONE index txn and ONE register
+            # txn cover several hash batches — every meta txn fights the
+            # encode lanes for the GIL, so txn count is latency
+            items = [item]
+            while True:
+                try:
+                    nxt = self._finalq.get_nowait()
+                except self._empty:
+                    break
+                if nxt is None:
+                    self._finalq.put(None)  # re-arm the close sentinel
+                    break
+                items.append(nxt)
+            index_rows = [r for rows, _j in items if rows for r in rows]
+            jobs = [j for _r, js in items for j in js]
+            if index_rows:
+                try:
+                    self.refs.meta.set_block_digests(index_rows)
+                except Exception as e:  # advisory: gc backfills the index
+                    logger.warning("content-index batch failed: %s", e)
             try:
                 self._finalize(jobs)
             except Exception as e:
                 logger.warning("ingest finalize degraded: %s", e)
                 for _digest, members, _pf in jobs:
                     for m in members:
-                        if not m[3].done():
-                            m[3].set_exception(e)
+                        # races the early-ack PUT callback: first wins
+                        _settle_future(m[3], e)
+            finally:
+                self._settle_inflight([d for d, _m, _pf in jobs])
+                with self._lock:
+                    self._final_pending -= len(items)
 
     def _finalize(self, jobs: list) -> None:
         ok: list = []  # (digest, members) whose canonical PUT landed
@@ -408,6 +733,9 @@ class IngestPipeline:
                 continue
             _UPLOADED.inc()
             self.uploaded += 1
+            # leader already early-acked by the PUT done-callback
+            # (_process); followers wait register+incref below — their
+            # ack must imply a reachable alias row
             ok.append((digest, members))
         if not ok:
             return
@@ -441,7 +769,6 @@ class IngestPipeline:
                     self.store.storage.delete(leader[0])
                 except Exception:
                     pass  # a leaked duplicate object; gc collects it
-            leader[3].set_result(None)
             if results is not None:
                 followers.extend((digest, m) for m in members[1:])
             else:
@@ -487,7 +814,7 @@ class IngestPipeline:
         deadline = _time.monotonic() + timeout
         while _time.monotonic() < deadline:
             with self._lock:
-                if not self._outstanding:
+                if not self._outstanding and self._final_pending == 0:
                     return
             _time.sleep(0.005)
         raise TimeoutError("ingest pipeline did not drain")
@@ -506,7 +833,7 @@ class IngestPipeline:
             self._finalizer.join(timeout)
 
     def stats(self) -> dict:
-        return {
+        out = {
             "backend": self.backend,
             "blocks": self.blocks,
             "put_elided": self.elided,
@@ -516,3 +843,11 @@ class IngestPipeline:
             "race_collapsed": self.race_collapsed,
             "errors": self.errors,
         }
+        if self.governor is not None:
+            out["bypass"] = self.governor.stats()
+        if self._hot is not None:
+            out["hot_content"] = self._hot.stats()
+        plane = getattr(self.store, "compress_plane", None)
+        if plane is not None:
+            out["compress"] = plane.stats()
+        return out

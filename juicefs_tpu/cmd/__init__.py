@@ -89,6 +89,13 @@ def chunk_conf(fmt: Format, args=None) -> ChunkConfig:
         conf.attempt_timeout = float(args.attempt_timeout)
     if getattr(args, "no_hedge", False):
         conf.hedge = False
+    # batched compression plane + elision bypass (ISSUE 8)
+    if getattr(args, "compress_backend", None):
+        conf.compress_backend = str(args.compress_backend)
+    if getattr(args, "compress_lanes", None):
+        conf.compress_lanes = int(args.compress_lanes)
+    if getattr(args, "no_dedup_bypass", False):
+        conf.dedup_bypass = False
     return conf
 
 
@@ -141,6 +148,7 @@ def build_store(fmt: Format, args=None, meta=None,
                 store.content_refs,
                 backend=pipeline_backend(fmt.hash_backend),
                 flush_timeout=max(0.0, float(flush_ms)) / 1e3,
+                bypass=conf.dedup_bypass,
             )
     return store
 

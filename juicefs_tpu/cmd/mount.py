@@ -53,6 +53,20 @@ def add_parser(sub):
                    help="max time a partial ingest hash batch waits for "
                         "more blocks before flushing (single-block write "
                         "latency bound)")
+    p.add_argument("--compress-backend", default="cpu",
+                   choices=["cpu", "xla"],
+                   help="batched compression plane backend (ISSUE 8): "
+                        "cpu fans liblz4 out across the qos slice lane; "
+                        "xla adds a device compressibility estimator "
+                        "riding the hash plane's packed H2D upload "
+                        "(degrades to cpu when no accelerator)")
+    p.add_argument("--compress-lanes", type=int, default=0,
+                   help="parallel encode lanes for batched compression "
+                        "(0 = host cores)")
+    p.add_argument("--no-dedup-bypass", action="store_true",
+                   help="disable the adaptive elision bypass: always "
+                        "hash+lookup every block even when the sampled "
+                        "duplicate density is ~zero (ISSUE 8)")
     p.add_argument("--cache-group", default="",
                    help="join this named peer cache group: serve the local "
                         "block cache to peers and read peers' caches before "

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 import time
 import uuid
 from typing import Iterator
@@ -20,6 +21,27 @@ class FileStorage(ObjectStorage):
     def __init__(self, root: str):
         # file:///abs/path arrives as "/abs/path"; relative allowed for tests
         self.root = root if root.endswith("/") else root + "/"
+        # ensured-directory cache (ISSUE 8 upload pipelining): the block
+        # namespace reuses a handful of chunks/a/b dirs, and the
+        # per-PUT makedirs walk costs 3+ stats per call — expensive on
+        # network filesystems. delete()'s empty-dir pruning invalidates;
+        # put() additionally retries once on a lost race.
+        self._dirs: set[str] = set()
+        self._dirs_lock = threading.Lock()
+
+    def _ensure_dir(self, d: str) -> None:
+        with self._dirs_lock:
+            if d in self._dirs:
+                return
+        os.makedirs(d, exist_ok=True)
+        with self._dirs_lock:
+            if len(self._dirs) >= 4096:
+                self._dirs.clear()  # unbounded key space: cheap reset
+            self._dirs.add(d)
+
+    def _forget_dir(self, d: str) -> None:
+        with self._dirs_lock:
+            self._dirs.discard(d)
 
     def string(self) -> str:
         return f"file://{self.root}"
@@ -43,18 +65,31 @@ class FileStorage(ObjectStorage):
 
     def put(self, key: str, data: bytes) -> None:
         path = self._path(key)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=".tmp.")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
-        except BaseException:
+        d = os.path.dirname(path)
+        for attempt in (0, 1):
+            self._ensure_dir(d)
             try:
-                os.unlink(tmp)
+                fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp.")
             except FileNotFoundError:
-                pass
-            raise
+                # lost the race against delete()'s empty-dir pruning:
+                # the cached dir vanished between check and create —
+                # recreate and retry once (once the temp file exists the
+                # dir is non-empty, so rmdir cannot take it again)
+                self._forget_dir(d)
+                if attempt:
+                    raise
+                continue
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+                return
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except FileNotFoundError:
+                    pass
+                raise
 
     def delete(self, key: str) -> None:
         try:
@@ -69,6 +104,7 @@ class FileStorage(ObjectStorage):
                 os.rmdir(d)
             except OSError:
                 break
+            self._forget_dir(d)
             d = os.path.dirname(d)
 
     def head(self, key: str) -> Obj:

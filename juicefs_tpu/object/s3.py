@@ -22,8 +22,10 @@ import hashlib
 import hmac
 import http.client
 import os
+import threading
 import urllib.parse
 import xml.etree.ElementTree as ET
+from collections import deque
 from typing import Iterator, Optional
 
 from ..utils import get_logger
@@ -45,6 +47,62 @@ _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 def _uri_escape(s: str, keep_slash: bool) -> str:
     safe = "/-_.~" if keep_slash else "-_.~"
     return urllib.parse.quote(s, safe=safe)
+
+
+class _ConnPool:
+    """Bounded per-backend keep-alive connection pool (ISSUE 8 upload
+    pipelining).
+
+    Object-op attempts run on the resilience layer's ELASTIC threads
+    (object/resilient.py), so a purely thread-local connection re-pays
+    the TCP(+TLS) handshake whenever the elastic pool grows, rotates, or
+    abandons a hung attempt. A small cross-thread free-list keeps
+    connections hot: callers check out around one request/response and
+    check back in only after the body is fully read (http.client cannot
+    interleave).  Broken or `Connection: close`d sockets are discarded,
+    mirroring the read side's keep-alive peer connections
+    (cache/group.py)."""
+
+    def __init__(self, factory, limit: int = 16):
+        self._factory = factory
+        self._limit = max(1, limit)
+        self._free: deque = deque()
+        self._lock = threading.Lock()
+        self.created = 0
+        self.reused = 0
+
+    def acquire(self):
+        with self._lock:
+            if self._free:
+                self.reused += 1
+                return self._free.pop()
+            self.created += 1
+        return self._factory()
+
+    def release(self, conn) -> None:
+        with self._lock:
+            if len(self._free) < self._limit:
+                self._free.append(conn)
+                return
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def discard(self, conn) -> None:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._free = list(self._free), deque()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
 
 
 class SigV4:
@@ -171,19 +229,18 @@ class S3Storage(ObjectStorage):
         self.signer = SigV4(ak, sk, region) if ak else None
         port = int(hostport.rsplit(":", 1)[1]) if ":" in hostport else 80
         self.tls = port == 443 or os.environ.get("JFS_S3_TLS") == "1"
-        self._local = __import__("threading").local()
+        self._pool = _ConnPool(self._new_conn)
 
     def string(self) -> str:
         return f"s3://{self.host}/{self.bucket}/{self.prefix}"
 
     # ---- plumbing --------------------------------------------------------
-    def _conn(self) -> http.client.HTTPConnection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            cls = http.client.HTTPSConnection if self.tls else http.client.HTTPConnection
-            conn = cls(self.host, timeout=60)
-            self._local.conn = conn
-        return conn
+    def _new_conn(self) -> http.client.HTTPConnection:
+        cls = http.client.HTTPSConnection if self.tls else http.client.HTTPConnection
+        return cls(self.host, timeout=60)
+
+    def close(self) -> None:
+        self._pool.close()
 
     def _request(
         self,
@@ -193,6 +250,7 @@ class S3Storage(ObjectStorage):
         body: bytes = b"",
         headers: Optional[dict[str, str]] = None,
         retry_reset: bool = True,
+        fresh: bool = False,
     ):
         path = "/" + self.bucket
         if key:
@@ -214,17 +272,26 @@ class S3Storage(ObjectStorage):
             hdrs["Content-Length"] = str(len(body))
         qs = urllib.parse.urlencode(query)
         url = path + ("?" + qs if qs else "")
-        conn = self._conn()
+        # the retry must BYPASS the pool: after an idle gap the server may
+        # have closed every parked socket, and drawing another stale one
+        # would fail a healthy backend twice
+        conn = self._new_conn() if fresh else self._pool.acquire()
         try:
             conn.request(method, url, body=body or None, headers=hdrs)
             resp = conn.getresponse()
             data = resp.read()
         except (http.client.HTTPException, OSError):
-            conn.close()
-            self._local.conn = None
+            # stale keep-alive (server closed an idle pooled socket) or a
+            # genuinely broken conn: drop it, retry once on a fresh one
+            self._pool.discard(conn)
             if not retry_reset:
                 raise
-            return self._request(method, key, query, body, headers, retry_reset=False)
+            return self._request(method, key, query, body, headers,
+                                 retry_reset=False, fresh=True)
+        if resp.will_close:
+            self._pool.discard(conn)
+        else:
+            self._pool.release(conn)
         return resp.status, dict(resp.getheaders()), data
 
     @staticmethod

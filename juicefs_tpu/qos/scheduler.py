@@ -279,13 +279,16 @@ class Scheduler:
                 ln.width = max(1, int(width))
                 ln.cond.notify_all()
 
-    def submit(self, lane: str, cls: IOClass, fn: Callable, *args, tenant=None, weight: Optional[int]=None, cost: int=1, **kw) -> Optional[Future]:
+    def submit(self, lane: str, cls: IOClass, fn: Callable, *args, tenant=None, weight: Optional[int]=None, cost: int=1, nowait: bool=False, **kw) -> Optional[Future]:
         """Queue `fn(*args, **kw)` at `cls` priority on `lane`.
 
         Returns a Future, or None when the class is sheddable and its
         queue is full (the task was dropped and counted).  INGEST and
         BACKGROUND submits block for queue space (backpressure);
-        FOREGROUND is unbounded and never waits.
+        FOREGROUND is unbounded and never waits.  `nowait=True` turns
+        the backpressure wait into an immediate TimeoutError — for
+        callers with their own serial fallback (the compression plane's
+        lane fan-out, ISSUE 8) that must degrade rather than park.
 
         tenant/weight default to the ambient QoS context (qos/context.py);
         the effective class never escalates above the ambient class.
@@ -322,6 +325,10 @@ class Scheduler:
                     with self._stats_lock:
                         self._shed[requested] += 1
                     return None
+                if nowait:
+                    raise TimeoutError(
+                        f'qos: {cls.label} queue on lane {lane!r} full '
+                        '(nowait submit)')
                 deadline = time.monotonic() + self.bound_wait
                 while q.size >= bound:
                     left = deadline - time.monotonic()

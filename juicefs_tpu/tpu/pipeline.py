@@ -21,7 +21,13 @@ import numpy as np
 
 from ..metric import global_registry
 from ..metric.trace import global_tracer, stage_hist
-from .jth256 import BLOCK_BYTES, LANE_BYTES, digests_to_bytes, pack_blocks
+from .jth256 import (
+    BLOCK_BYTES,
+    LANE_BYTES,
+    digests_to_bytes,
+    hash_packed_np,
+    pack_blocks,
+)
 
 _reg = global_registry()
 _BLOCKS_HASHED = _reg.counter(
@@ -150,6 +156,35 @@ class HashPipeline:
     def hash_blocks(self, blocks: Iterable[bytes]) -> list[bytes]:
         return [d for _, d in self.hash_stream((str(i), b) for i, b in enumerate(blocks))]
 
+    @property
+    def device_backend(self) -> bool:
+        """True when digests come off an accelerator (post-degrade)."""
+        return self._fn is not None
+
+    def hash_packed(self, words, counts, lengths) -> list[bytes]:
+        """Digest a pre-packed batch (shared-H2D contract, ISSUE 8): the
+        caller packs once and the SAME upload feeds hash and compress
+        outputs. On the cpu backend this is the vectorized numpy path —
+        byte-identical, no transfer (h2d counter untouched)."""
+        n = len(counts)
+        with _TR.span("tpu", "hash", stage="dispatch",
+                      hist=_H_DISPATCH) as sp:
+            if sp.active:
+                sp.set(batch=n, bytes=int(lengths.sum()),
+                       backend=self.config.backend)
+            if self._fn is None:
+                out = hash_packed_np(words, counts, lengths)
+            else:
+                _H2D_BYTES.inc(words.nbytes)
+                out = self._fn(words, counts, lengths)
+        _BATCH_BLOCKS.observe(n)
+        _BLOCKS_HASHED.inc(n)
+        _HASH_BYTES.inc(int(lengths.sum()))
+        with _TR.span("tpu", "hash", stage="drain", hist=_H_DRAIN) as sp:
+            if sp.active:
+                sp.set(batch=n, backend=self.config.backend)
+            return digests_to_bytes(np.asarray(out))
+
 
 _FLUSH = object()  # kick(): hash whatever is buffered NOW (commit barrier)
 _CLOSE = object()
@@ -207,17 +242,34 @@ class HashBatcher:
             pass
 
     def close(self) -> None:
+        """Non-blocking by contract (ISSUE 8 satellite): the old
+        blocking `put(_CLOSE)` could park the closer behind a saturated
+        consumer when the queue was full. The closed flag is the
+        authoritative signal — the consumer drains everything accepted
+        before the flag, then exits on an empty queue; the sentinel is
+        only a wake-up fast path and is dropped when there is no room."""
         self._closed = True
-        self._q.put(_CLOSE)
+        try:
+            self._q.put_nowait(_CLOSE)
+        except Exception:
+            pass
 
     def qsize(self) -> int:
         return self._q.qsize()
 
     def batches(self) -> Iterator[list]:
-        """Consumer side: yield non-empty item batches until close()."""
+        """Consumer side: yield non-empty item batches until close().
+        Drain guard: a close() that could not enqueue its sentinel (full
+        queue) still terminates this loop — every accepted item is
+        yielded first, then the closed+empty state ends it."""
         batch_blocks = max(1, self.pipe.config.batch_blocks)
         while True:
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=0.1)
+            except self._empty:
+                if self._closed:
+                    return
+                continue
             if item is _CLOSE:
                 return
             if item is _FLUSH:

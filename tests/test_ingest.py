@@ -413,7 +413,7 @@ def test_leader_put_failure_fails_the_whole_group(vol):
     boom = IOError("backend exploded")
     orig = store._put_block
 
-    def bad_put(key, raw, parent=None, fingerprint=True):
+    def bad_put(key, raw, parent=None, fingerprint=True, data=None):
         raise boom
 
     store._put_block = bad_put
@@ -521,9 +521,9 @@ def test_staged_memory_spills_past_cap(tmp_path):
         orig = store._put_block
         gate = threading.Event()
 
-        def slow_put(key, raw, parent=None, fingerprint=True):
+        def slow_put(key, raw, parent=None, fingerprint=True, data=None):
             gate.wait(5.0)
-            return orig(key, raw, parent, fingerprint)
+            return orig(key, raw, parent, fingerprint, data)
 
         store._put_block = slow_put
         for i, d in enumerate(datas):
@@ -588,7 +588,7 @@ def test_breaker_open_mid_ingest_stages_whole_group(vol):
     orig = store._put_block
     calls = {"n": 0}
 
-    def tripping(key, raw, parent=None, fingerprint=True):
+    def tripping(key, raw, parent=None, fingerprint=True, data=None):
         calls["n"] += 1
         raise BreakerOpenError("open")
 
@@ -729,3 +729,212 @@ def test_hash_batcher_flush_timeout_bounds_latency():
     assert out == [["lonely"]]
     hb.close()
     t.join(5.0)
+
+
+# ---------------------------------------------------------------------------
+# Adaptive elision bypass (ISSUE 8): the governor's state machine and its
+# wiring into the ingest stage.
+# ---------------------------------------------------------------------------
+
+def test_governor_state_machine():
+    from juicefs_tpu.chunk.bypass import ElisionGovernor
+
+    g = ElisionGovernor(window=16, min_samples=8, low_water=0.1,
+                        high_water=0.3, probe_every=4)
+    # below min_samples every block runs the dedup path, whatever the rate
+    for _ in range(7):
+        assert g.admit() == g.DEDUP
+        g.record(False)
+    assert not g.bypassing
+    assert g.admit() == g.DEDUP
+    g.record(False)  # 8th zero-hit sample crosses the low-water mark
+    assert g.bypassing
+    # in bypass: exactly every probe_every-th verdict is a shadow PROBE
+    verdicts = [g.admit() for _ in range(8)]
+    assert verdicts.count(g.PROBE) == 2
+    assert verdicts[0] == g.BYPASS
+    assert g.DEDUP not in verdicts
+    # probe hits push the windowed rate past high_water -> re-engage
+    rounds = 0
+    while g.bypassing and rounds < 200:
+        if g.admit() == g.PROBE:
+            g.record(True)
+        rounds += 1
+    assert not g.bypassing
+    st = g.stats()
+    assert st["transitions"] == 2
+    assert st["bypassed"] >= 6 and st["probes"] >= 1
+
+
+def test_governor_dup_heavy_stream_never_bypasses():
+    from juicefs_tpu.chunk.bypass import ElisionGovernor
+
+    g = ElisionGovernor(window=16, min_samples=8, low_water=0.1,
+                        high_water=0.3)
+    for i in range(200):
+        # ~33% hit rate: dedup stays engaged throughout
+        assert g.admit() == g.DEDUP
+        g.record(i % 3 == 0)
+    assert not g.bypassing and g.stats()["bypassed"] == 0
+
+
+def test_governor_hysteresis_gap_validated():
+    from juicefs_tpu.chunk.bypass import ElisionGovernor
+
+    with pytest.raises(ValueError):
+        ElisionGovernor(low_water=0.5, high_water=0.2)
+
+
+def test_bypass_engages_on_zero_dup_stream_and_stays_durable(vol):
+    from juicefs_tpu.chunk.bypass import ElisionGovernor
+
+    meta, store, counting = vol
+    store.ingest.governor = ElisionGovernor(window=16, min_samples=8,
+                                            probe_every=4)
+    datas = [os.urandom(BS) for _ in range(32)]
+    for i, d in enumerate(datas):
+        _write(store, 700 + i, d)
+    store.ingest.flush()
+    st = store.ingest.stats()
+    assert st["bypass"]["state"] == "bypass"
+    assert st["bypass"]["bypassed"] > 0
+    assert st["bypass"]["probes"] >= 1  # probes keep sampling density
+    assert st["passthrough"] == 0  # bypass is not a degrade
+    # every block durable and readable — bypassed ones included
+    assert len(counting.put_keys) == 32  # nothing elided, nothing lost
+    for i, d in enumerate(datas):
+        assert bytes(store.new_reader(700 + i, BS).read(0, BS)) == d
+
+
+def test_bypass_disengages_when_dups_return(vol):
+    from juicefs_tpu.chunk.bypass import ElisionGovernor
+
+    meta, store, counting = vol
+    gov = ElisionGovernor(window=16, min_samples=8, low_water=0.1,
+                          high_water=0.3, probe_every=2)
+    store.ingest.governor = gov
+    for i in range(16):  # unique stream: engage bypass
+        _write(store, 800 + i, os.urandom(BS))
+    store.ingest.flush()
+    assert gov.bypassing
+    dup = os.urandom(BS)
+    _write(store, 850, dup)  # park the content (digestless probe entry)
+    for i in range(60):  # heavy-dup phase: shadow probes re-engage dedup
+        _write(store, 851 + i, dup)
+        if not gov.bypassing:
+            break
+    assert not gov.bypassing
+    for i in range(8):  # post-re-engagement dups flow the full path
+        _write(store, 950 + i, dup)
+    store.ingest.flush()
+    assert store.ingest.elided > 0  # elision resumed after re-engage
+
+
+def test_ingest_batched_compress_routes_through_plane(meta, tmp_path):
+    """MISS leaders compress as a batch on the finalizer side (plane
+    batch counter), and the stored bytes stay lz4-compatible."""
+    storage = create_storage(f"file://{tmp_path}/blob-bc")
+    storage.create()
+    counting = CountingStore(storage)
+    store = CachedStore(counting, ChunkConfig(block_size=BS, cache_size=1,
+                                              compress="lz4"))
+    refs = ContentRefs(meta)
+    store.content_refs = refs
+    store.ingest = IngestPipeline(store, refs, backend="cpu",
+                                  batch_blocks=8, flush_timeout=0.005)
+    try:
+        datas = [os.urandom(BS) for _ in range(8)]
+        _write(store, 900, *datas)
+        store.ingest.flush()
+        plane = store.compress_plane
+        assert plane.batches >= 1  # the finalizer-side batch seam ran
+        assert plane.blocks >= len(datas)
+        r = store.new_reader(900, 8 * BS)
+        for j, d in enumerate(datas):
+            assert bytes(r.read(j * BS, BS)) == d
+    finally:
+        store.close()
+
+
+def test_hash_batcher_close_nonblocking_on_full_queue():
+    """ISSUE 8 satellite: close() must not park behind a saturated
+    consumer — and the drain guard still yields accepted items."""
+    from juicefs_tpu.tpu.pipeline import HashBatcher, HashPipeline, PipelineConfig
+
+    hb = HashBatcher(HashPipeline(PipelineConfig(backend="cpu",
+                                                 batch_blocks=4)),
+                     queue_blocks=4, flush_timeout=0.01)
+    for i in range(4):
+        assert hb.submit(f"item{i}")
+    assert not hb.submit("overflow")  # queue full
+    t0 = time.monotonic()
+    hb.close()  # full queue: the old blocking put() would park here
+    assert time.monotonic() - t0 < 0.5
+    got = [item for batch in hb.batches() for item in batch]
+    assert got == [f"item{i}" for i in range(4)]  # accepted items drain
+    assert not hb.submit("post-close")
+
+
+def test_ingest_device_backend_shares_packed_upload(meta, tmp_path):
+    """With a device hash backend, ONE pack_blocks batch feeds both the
+    hash digests and the compress plane's estimator (ISSUE 8 shared-H2D
+    contract) — and elision stays byte-exact."""
+    pytest.importorskip("jax")
+    storage = create_storage(f"file://{tmp_path}/blob-xla")
+    storage.create()
+    counting = CountingStore(storage)
+    store = CachedStore(counting, ChunkConfig(
+        block_size=BS, cache_size=1, compress="lz4",
+        compress_backend="xla"))
+    refs = ContentRefs(meta)
+    store.content_refs = refs
+    store.ingest = IngestPipeline(store, refs, backend="xla",
+                                  batch_blocks=4, flush_timeout=0.005,
+                                  hot_bytes=0)  # force every block hashed
+    try:
+        dup = os.urandom(BS)
+        datas = [dup, os.urandom(BS), dup, os.urandom(BS)]
+        _write(store, 960, *datas)
+        store.ingest.flush()
+        st = store.ingest.stats()
+        assert st["put_elided"] == 1 and st["errors"] == 0
+        assert store.compress_plane.estimated >= 4  # rode the shared pack
+        r = store.new_reader(960, 4 * BS)
+        for j, d in enumerate(datas):
+            assert bytes(r.read(j * BS, BS)) == d
+    finally:
+        store.close()
+
+
+def test_governor_defaults_and_boundaries():
+    """Default knobs are part of the tuning contract (the bench and
+    mounts run them), and the threshold comparisons are boundary-exact:
+    bypass strictly below low_water, re-engage AT high_water."""
+    from juicefs_tpu.chunk.bypass import ElisionGovernor
+
+    g = ElisionGovernor()
+    assert (g.window, g.min_samples, g.probe_every) == (64, 16, 16)
+    assert (g.low_water, g.high_water) == (0.05, 0.15)
+    # inclusive validation boundaries: 0.0 and 1.0 are legal waters
+    ElisionGovernor(low_water=0.0, high_water=1.0)
+    ElisionGovernor(low_water=0.2, high_water=0.2)
+    # floors: degenerate knobs clamp instead of breaking the sampler
+    tiny = ElisionGovernor(window=1, min_samples=0, probe_every=0)
+    assert tiny.window == 4 and tiny.min_samples == 1
+    assert tiny.probe_every == 2
+
+    # exactly AT low_water must NOT bypass (strictly-below contract)
+    g = ElisionGovernor(window=10, min_samples=10, low_water=0.1,
+                        high_water=0.3)
+    for i in range(10):
+        g.record(i == 0)  # 1 hit / 10 = exactly low_water
+    assert not g.bypassing
+    # exactly AT high_water must re-engage (inclusive contract)
+    g = ElisionGovernor(window=10, min_samples=5, low_water=0.05,
+                        high_water=0.3)
+    for _ in range(10):
+        g.record(False)
+    assert g.bypassing
+    for _ in range(3):  # 3 hits / 10 window = exactly high_water
+        g.record(True)
+    assert not g.bypassing

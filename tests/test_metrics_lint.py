@@ -163,3 +163,53 @@ def test_cli_entrypoint_exits_zero():
     )
     assert p.returncode == 0, p.stderr
     assert "OK" in p.stdout
+
+
+def test_compress_registry_pinned():
+    """The juicefs_compress_* series (ISSUE 8: batch size histogram,
+    bytes in/out, ratio, degrade counter) must all exist; nothing
+    squats under the prefix."""
+    lint = _load_lint()
+    assert lint.lint_compress() == []
+    from juicefs_tpu.metric import Registry
+
+    reg = Registry()
+    reg.counter("juicefs_compress_rogue", "unreviewed")
+    problems = lint.lint_compress(registry=reg)
+    text = "\n".join(problems)
+    assert "juicefs_compress_ratio" in text  # missing expected
+    assert "rogue" in text                    # stray under prefix
+
+
+def test_compress_seam_lint():
+    """Write-path compression in chunk/ must route through the batched
+    plane: passes on the real tree, bites on a synthetic chunk module
+    calling compressor.compress directly."""
+    import tempfile
+
+    lint = _load_lint()
+    assert lint.lint_compress_seam() == []
+    with tempfile.TemporaryDirectory() as root:
+        chunkdir = os.path.join(root, "chunk")
+        os.makedirs(chunkdir)
+        with open(os.path.join(chunkdir, "cached_store.py"), "w") as f:
+            f.write(
+                "class CachedStore:\n"
+                "    def _put_block(self, key, raw):\n"
+                "        data = self.compressor.compress(raw)\n"
+            )
+        problems = lint.lint_compress_seam(root)
+        # both defects: a bare compress call AND no plane seam in sight
+        text = "\n".join(problems)
+        assert "compressor.compress" in text or "bare" in text
+        assert any("compress_one" in p or "plane" in p for p in problems)
+        # decompress-side mentions must NOT trip it
+        with open(os.path.join(chunkdir, "cached_store.py"), "w") as f:
+            f.write(
+                "class CachedStore:\n"
+                "    def _put_block(self, key, raw):\n"
+                "        data = self.compress_plane.compress_one(raw)\n"
+                "    def _load(self, key, data, n):\n"
+                "        return self.compressor.decompress(data, n)\n"
+            )
+        assert lint.lint_compress_seam(root) == []

@@ -37,6 +37,18 @@ INGEST_EXPECTED = {
     "juicefs_ingest_race_collapsed",
     "juicefs_ingest_errors",
     "juicefs_ingest_queue_blocks",
+    # adaptive elision bypass (ISSUE 8, chunk/bypass.py)
+    "juicefs_ingest_bypass",
+    "juicefs_ingest_bypass_probes",
+}
+COMPRESS_PREFIX = "juicefs_compress_"
+COMPRESS_EXPECTED = {
+    # batched compression plane (ISSUE 8, tpu/compress_batch.py)
+    "juicefs_compress_batch_blocks",
+    "juicefs_compress_bytes_in",
+    "juicefs_compress_bytes_out",
+    "juicefs_compress_ratio",
+    "juicefs_compress_degraded",
 }
 QOS_PREFIX = "juicefs_qos_"
 QOS_EXPECTED = {
@@ -55,6 +67,7 @@ def populate_registry() -> None:
     runtime registrations that are cheap to trigger."""
     import juicefs_tpu.cache.group          # noqa: F401  peer hit/miss/ring
     import juicefs_tpu.cache.server         # noqa: F401  peer served counters
+    import juicefs_tpu.chunk.bypass         # noqa: F401  elision-bypass counters
     import juicefs_tpu.chunk.cached_store   # noqa: F401  staging gauges
     import juicefs_tpu.chunk.disk_cache     # noqa: F401  disk tier counters
     import juicefs_tpu.chunk.ingest         # noqa: F401  inline-dedup counters
@@ -68,6 +81,7 @@ def populate_registry() -> None:
     import juicefs_tpu.object.sharding      # noqa: F401  shard routing counter
     import juicefs_tpu.qos.limiter          # noqa: F401  bandwidth throttling
     import juicefs_tpu.qos.scheduler        # noqa: F401  scheduler classes
+    import juicefs_tpu.tpu.compress_batch   # noqa: F401  compression plane
     import juicefs_tpu.tpu.pipeline         # noqa: F401  batch metrics
     from juicefs_tpu.metric import register_process_metrics
 
@@ -123,6 +137,7 @@ def run(files: list[SourceFile]) -> list[Finding]:
         + lint_pinned(CACHE_GROUP_PREFIX, CACHE_GROUP_EXPECTED, "cache-group")
         + lint_pinned(INGEST_PREFIX, INGEST_EXPECTED, "ingest")
         + lint_pinned(QOS_PREFIX, QOS_EXPECTED, "qos")
+        + lint_pinned(COMPRESS_PREFIX, COMPRESS_EXPECTED, "compress")
     )
     return [Finding("", 0, "metric-registry", p) for p in problems]
 

@@ -11,6 +11,10 @@ shim over these.
   through the ingest stage when the store has one.
 * ``qos-seam`` (PR 6): no bare ``ThreadPoolExecutor`` outside ``qos/``
   and the whitelisted resilience elastic pool.
+* ``compress-seam`` (ISSUE 8): write-path compression in ``chunk/``
+  routes through the batched compression plane — no bare
+  ``compressor.compress`` calls, and ``_put_block`` must actually reach
+  ``compress_plane.compress_one``.
 """
 
 from __future__ import annotations
@@ -150,15 +154,66 @@ def check_ingest_seam(sf: SourceFile) -> list[Finding]:
     return findings
 
 
+def run_compress_seam(files: list[SourceFile]) -> list[Finding]:
+    """Write-path compression must route through the batched plane
+    (ISSUE 8): a bare ``compressor.compress`` in ``chunk/`` silently
+    reverts to the serial in-worker encode, which no functional test
+    catches (output is byte-identical — only the wall time regresses).
+    The decompress side is exempt: reads stay on the compressor."""
+    findings: list[Finding] = []
+    store_sf = None
+    saw_pkg = False
+    for sf in files:
+        saw_pkg = saw_pkg or sf.rel.startswith("juicefs_tpu/")
+        rel = _pkg_rel(sf)
+        if not rel.startswith("chunk/") or sf.tree is None:
+            continue
+        if rel == "chunk/cached_store.py":
+            store_sf = sf
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "compress"):
+                v = node.func.value
+                holder = getattr(v, "attr", None) or getattr(v, "id", None)
+                if holder == "compressor":
+                    findings.append(Finding(
+                        sf.rel, node.lineno, "compress-seam",
+                        "bare compressor.compress on the write path — "
+                        "route through the batched compression plane "
+                        "(compress_plane.compress_one/compress_blocks)",
+                    ))
+    if store_sf is not None:
+        has_plane = any(
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("compress_one", "compress_blocks")
+            for node in ast.walk(store_sf.tree)
+        )
+        if not has_plane:
+            findings.append(Finding(
+                store_sf.rel, 0, "compress-seam",
+                "chunk/cached_store.py never calls the compression plane "
+                "(compress_plane.compress_one) — the batched-compress "
+                "seam is gone",
+            ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/chunk/cached_store.py", 0, "compress-seam",
+            "chunk/cached_store.py not found or unparseable",
+        ))
+    return findings
+
+
 def run(files: list[SourceFile]) -> list[Finding]:
     return (run_qos_seam(files) + run_resilience_seam(files)
-            + run_ingest_seam(files))
+            + run_ingest_seam(files) + run_compress_seam(files))
 
 
 PASS = Pass(
     name="seams",
-    rules=("qos-seam", "resilience-seam", "ingest-seam"),
+    rules=("qos-seam", "resilience-seam", "ingest-seam", "compress-seam"),
     run=run,
     doc="architecture seams: scheduler-only pools, resilience-wrapped "
-        "stores, ingest-guarded uploads",
+        "stores, ingest-guarded uploads, plane-routed compression",
 )

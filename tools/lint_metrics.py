@@ -28,6 +28,8 @@ INGEST_PREFIX = _metrics.INGEST_PREFIX
 INGEST_EXPECTED = _metrics.INGEST_EXPECTED
 QOS_PREFIX = _metrics.QOS_PREFIX
 QOS_EXPECTED = _metrics.QOS_EXPECTED
+COMPRESS_PREFIX = _metrics.COMPRESS_PREFIX
+COMPRESS_EXPECTED = _metrics.COMPRESS_EXPECTED
 
 _PKG_ROOT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "juicefs_tpu"
@@ -55,6 +57,17 @@ def lint_qos(registry=None) -> list[str]:
     return _metrics.lint_pinned(QOS_PREFIX, QOS_EXPECTED, "qos", registry)
 
 
+def lint_compress(registry=None) -> list[str]:
+    return _metrics.lint_pinned(COMPRESS_PREFIX, COMPRESS_EXPECTED,
+                                "compress", registry)
+
+
+def lint_compress_seam(root: str | None = None) -> list[str]:
+    """No-bare-compress check (ISSUE 8), framework-backed."""
+    files = load_files(root or _PKG_ROOT)
+    return [f.render() for f in _seams.run_compress_seam(files)]
+
+
 def lint_ingest_seam(path: str | None = None) -> list[str]:
     """No-bare-upload check (ISSUE 5), framework-backed."""
     path = path or os.path.join(_PKG_ROOT, "chunk", "cached_store.py")
@@ -78,7 +91,8 @@ def lint_resilience(root: str | None = None) -> list[str]:
 def main() -> int:
     problems = (lint() + lint_cache_group() + lint_ingest()
                 + lint_ingest_seam() + lint_resilience()
-                + lint_qos() + lint_qos_seam())
+                + lint_qos() + lint_qos_seam()
+                + lint_compress() + lint_compress_seam())
     if problems:
         for p in problems:
             print(f"lint_metrics: {p}", file=sys.stderr)

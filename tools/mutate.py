@@ -80,6 +80,11 @@ TEST_MAP = {
                                      "-k", "not cli"],
     "juicefs_tpu/utils/lockwatch": ["tests/test_analysis.py",
                                     "-k", "watchdog"],
+    # ISSUE 8: batched compression plane + adaptive elision bypass
+    "juicefs_tpu/tpu/compress_batch": ["tests/test_compress_batch.py"],
+    "juicefs_tpu/chunk/bypass": ["tests/test_ingest.py", "-k",
+                                 "governor or bypass"],
+    "juicefs_tpu/compress/__init__": ["tests/test_compress_batch.py"],
 }
 DEFAULT_TESTS = ["tests/test_meta.py", "tests/test_vfs.py"]
 
