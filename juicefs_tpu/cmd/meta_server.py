@@ -21,6 +21,12 @@ def add_parser(sub):
                         "start, compacted to a snapshot; empty = memory only)")
     p.add_argument("--fsync", default="everysec", choices=["always", "everysec"],
                    help="AOF durability: per-mutation or batched (Redis-style)")
+    p.add_argument("--replica-of", default="",
+                   help="host:port of a primary meta-server to replicate "
+                        "from: this instance SYNCs a snapshot, applies the "
+                        "live mutation stream, and serves read-only point "
+                        "reads for clients mounted with --meta-replica "
+                        "(ISSUE 9)")
     p.set_defaults(func=run)
 
 
@@ -28,9 +34,12 @@ def run(args) -> int:
     from ..meta.redis_server import RedisServer
 
     srv = RedisServer(args.host, args.port, data_path=args.data or None,
-                      fsync=args.fsync)
+                      fsync=args.fsync,
+                      replica_of=getattr(args, "replica_of", "") or None)
     port = srv.start()
     durable = f" (aof={args.data}, fsync={args.fsync})" if args.data else ""
-    print(f"meta-server listening on {args.host}:{port}{durable}", flush=True)
+    role = f" replicating {args.replica_of}" if getattr(args, "replica_of", "") else ""
+    print(f"meta-server listening on {args.host}:{port}{durable}{role}",
+          flush=True)
     srv.wait()
     return 0

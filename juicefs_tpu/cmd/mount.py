@@ -85,6 +85,26 @@ def add_parser(sub):
                    help="dentry cache TTL seconds (reference --entry-cache)")
     p.add_argument("--dir-entry-cache", type=float, default=1.0,
                    help="readdir snapshot TTL seconds")
+    p.add_argument("--attr-cache-ttl", type=float, default=0.0,
+                   help="META-layer attr lease TTL seconds (ISSUE 9): "
+                        "cached getattr/lookup serve with zero meta round "
+                        "trips; remote staleness is bounded by the TTL and "
+                        "usually far lower (the heartbeat change feed "
+                        "invalidates mid-lease). 0 = passthrough, byte-"
+                        "identical to an uncached client")
+    p.add_argument("--entry-cache-ttl", type=float, default=0.0,
+                   help="META-layer dentry lease TTL seconds (positive + "
+                        "bounded negative lookups); 0 disables")
+    p.add_argument("--meta-replica", default="",
+                   help="host:port of a meta-server read replica (started "
+                        "with meta-server --replica-of): read-only point "
+                        "reads route there, WATCH transactions stay on the "
+                        "primary, and replica lag is guarded by the volume "
+                        "change-epoch")
+    p.add_argument("--meta-op-limit", type=float, default=0,
+                   help="per-tenant meta ops/s (0 = unlimited): token-"
+                        "bucket throttling at the meta boundary — graceful "
+                        "queuing, never an error (ISSUE 9)")
     p.add_argument("--heartbeat", type=float, default=12.0,
                    help="session heartbeat interval seconds (also the push-"
                         "invalidation exchange cadence)")
@@ -141,6 +161,25 @@ def serve(args) -> int:
 
     m, fmt = open_meta(args.meta_url)
     storage_for(fmt)  # raises on a broken storage configuration
+
+    # meta-plane read scaling (ISSUE 9): replica routing is configured
+    # AFTER open_meta so the format load itself always reads the primary
+    # (a replica still syncing must not fail the mount)
+    replica = getattr(args, "meta_replica", "")
+    if replica:
+        cfg = getattr(getattr(m, "client", None), "configure_replica", None)
+        if cfg is not None:
+            cfg(replica)
+            logger.info("meta read replica: %s", replica)
+        else:
+            logger.warning("--meta-replica ignored: engine %s has no "
+                           "replica routing", m.name())
+    m.configure_meta_cache(
+        attr_ttl=getattr(args, "attr_cache_ttl", 0.0),
+        entry_ttl=getattr(args, "entry_cache_ttl", 0.0),
+    )
+    if getattr(args, "meta_op_limit", 0):
+        m.configure_op_limit(args.meta_op_limit)
 
     if args.heartbeat <= 0:
         logger.warning("--heartbeat %.1f invalid; using 1s", args.heartbeat)

@@ -17,6 +17,7 @@ from typing import Callable, Optional
 
 from ..utils import get_logger
 from . import interface
+from .cache import LeaseCache, MetaOpLimiter
 from .context import Context
 from .openfile import OpenFiles
 from .types import (
@@ -51,11 +52,27 @@ _UMOUNTED, _MOUNTED = 0, 1
 
 
 class BaseMeta(interface.Meta):
+    # engines with a change feed (the invalSeq journal exchanged on the
+    # session heartbeat) set this True; without one the lease cache below
+    # stays in TTL-0 passthrough — remote staleness could not even be
+    # accelerated, so it is not cached at all (ISSUE 9).
+    supports_inval_feed = False
+
     def __init__(self, addr: str):
         self.addr = addr
         self.fmt: Format = Format()
         self.sid: int = 0
         self.of = OpenFiles()
+        # lease-based attr/dentry cache in front of the do_* engine ops
+        # (meta/cache.py, ISSUE 9). Disabled (TTL 0) until
+        # configure_meta_cache — the default path is byte-identical to
+        # the uncached engine. Every of.invalidate site (including the
+        # ones inside engine transactions, e.g. a rename victim) also
+        # drops the lease through this hook.
+        self.lease = LeaseCache()
+        self.of.on_invalidate = lambda ino: self.lease.invalidate_attr(ino)
+        # per-tenant meta-op token buckets (--meta-op-limit, ISSUE 9)
+        self.op_limiter: Optional[MetaOpLimiter] = None
         self.msg_callbacks: dict[int, Callable] = {}
         self._lock = threading.Lock()
         # batched id allocation (reference base.go:946 freeID batching)
@@ -98,7 +115,12 @@ class BaseMeta(interface.Meta):
     def do_reset(self) -> None: ...
     def do_new_inodes(self, n: int) -> int: ...
     def do_new_slices(self, n: int) -> int: ...
-    def do_lookup(self, parent: int, name: bytes) -> tuple[int, int, Attr]: ...
+    def do_lookup(self, parent: int, name: bytes, hint_ino: int = 0) -> tuple[int, int, Attr]:
+        """`hint_ino` is the lease cache's last-known child ino (0 = no
+        hint): engines may speculatively batch its attr into the same
+        round trip as the dentry read, revalidating against the live
+        entry — a warm-but-expired lookup is then ONE round trip."""
+        ...
     def do_getattr(self, ino: int) -> tuple[int, Attr]: ...
     def do_setattr(self, ctx, ino, flags, attr: Attr) -> tuple[int, Attr]: ...
     def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path) -> tuple[int, int, Attr]: ...
@@ -148,6 +170,58 @@ class BaseMeta(interface.Meta):
     # -- lifecycle ---------------------------------------------------------
     def name(self) -> str:
         return "base"
+
+    # -- lease cache / op throttle configuration (ISSUE 9) -----------------
+    def configure_meta_cache(self, attr_ttl: float = 0.0,
+                             entry_ttl: float = 0.0,
+                             neg_ttl: Optional[float] = None,
+                             maxsize: int = 100_000) -> None:
+        """Enable the lease-based attr/dentry cache (--attr-cache-ttl /
+        --entry-cache-ttl).  TTL 0 disables a side entirely; an engine
+        without the change feed is forced to TTL-0 passthrough — without
+        even accelerated invalidation, remote staleness is served from
+        the store, never from a lease."""
+        if (attr_ttl > 0 or entry_ttl > 0) and not self.supports_inval_feed:
+            logger.warning(
+                "meta engine %s has no invalidation feed; lease cache "
+                "stays in TTL-0 passthrough", self.name())
+            attr_ttl = entry_ttl = 0.0
+        self.lease = LeaseCache(attr_ttl, entry_ttl, neg_ttl, maxsize)
+
+    def configure_op_limit(self, ops_per_sec: float) -> None:
+        """Per-tenant meta-op throttling (--meta-op-limit).  0 disables."""
+        self.op_limiter = (MetaOpLimiter(ops_per_sec)
+                           if ops_per_sec and ops_per_sec > 0 else None)
+
+    def _throttle(self, ctx) -> None:
+        """Gate one meta op against the caller's tenant bucket: graceful
+        queuing on the calling thread, never an error.  The tenant is the
+        ambient QoS tenant when one is scoped (vfs ops tag the request
+        uid), else the context uid."""
+        lim = self.op_limiter
+        if lim is None:
+            return
+        from ..qos import context as qctx
+
+        amb = qctx.current()
+        tenant = amb.tenant if amb is not None else getattr(ctx, "uid", 0)
+        lim.acquire(tenant)
+
+    def _attr_cached(self, ino: int) -> tuple[int, Optional[Attr]]:
+        """Attr via the open-file and lease caches; a miss falls through
+        to the engine and primes the lease.  With the lease cache
+        disabled this IS `do_getattr` — the uncached path stays
+        byte-identical to a build without the cache layer."""
+        if self.lease.enabled:
+            attr = self.of.attr(ino)
+            if attr is None:
+                attr = self.lease.get_attr(ino)
+            if attr is not None:
+                return 0, attr
+        st, attr = self.do_getattr(ino)
+        if st == 0:
+            self.lease.put_attr(ino, attr)
+        return st, attr
 
     def init(self, fmt: Format, force: bool = False) -> int:
         """Create/overwrite the volume format record (reference cmd/format.go)."""
@@ -308,9 +382,18 @@ class BaseMeta(interface.Meta):
         return out
 
     def _note_change(self, *events: tuple) -> None:
-        """Record local mutations for the next heartbeat's publish. No-op
-        until a session with callbacks-or-peers exists (tools that run
-        without sessions pay nothing)."""
+        """Record local mutations for the next heartbeat's publish, and
+        apply them to the local lease cache synchronously (write-through:
+        every mutating op names its victims here, so read-your-own-writes
+        holds regardless of lease TTLs). Publishing is a no-op until a
+        session with callbacks-or-peers exists (tools that run without
+        sessions pay nothing)."""
+        if self.lease.enabled:
+            for ev in events:
+                if ev[0] == "a":
+                    self.lease.invalidate_attr(ev[1])
+                else:
+                    self.lease.invalidate_entry(ev[1], ev[2])
         if not self.sid:
             return
         with self._inval_mu:
@@ -341,7 +424,9 @@ class BaseMeta(interface.Meta):
             for ev in events:
                 kind = ev[0]
                 if kind == "a":
-                    self.of.invalidate(ev[1])
+                    self.of.invalidate(ev[1])  # also drops the attr lease
+                elif kind == "e" and self.lease.enabled:
+                    self.lease.invalidate_entry(ev[1], ev[2])
             for cb in self._inval_cbs:
                 try:
                     cb(events)
@@ -375,7 +460,7 @@ class BaseMeta(interface.Meta):
         if ctx.uid == 0 or not ctx.check_permission:
             return 0
         if attr is None or not attr.full:
-            st, attr = self.do_getattr(ino)
+            st, attr = self._attr_cached(ino)
             if st:
                 return st
         # extended ACL evaluation (reference base.go:871-880; skipped when
@@ -480,29 +565,48 @@ class BaseMeta(interface.Meta):
 
     # -- namespace ops -----------------------------------------------------
     def lookup(self, ctx: Context, parent: int, name: bytes) -> tuple[int, int, Attr]:
+        self._throttle(ctx)
         if name == b"..":
-            st, pattr = self.do_getattr(parent)
+            st, pattr = self._attr_cached(parent)
             if st:
                 return st, 0, Attr()
             if pattr.typ != TYPE_DIRECTORY:
                 return errno.ENOTDIR, 0, Attr()
-            st, gattr = self.do_getattr(pattr.parent)
+            st, gattr = self._attr_cached(pattr.parent)
             return st, pattr.parent, gattr
         if name == b".":
-            st, attr = self.do_getattr(parent)
+            st, attr = self._attr_cached(parent)
             return st, parent, attr
         st = self.access(ctx, parent, MODE_MASK_X)
         if st:
             return st, 0, Attr()
-        st, ino, attr = self.do_lookup(parent, name)
+        # lease-cache fast path: a live dentry + attr lease serves the
+        # whole lookup with zero engine round trips (the dataloader's
+        # stat/open-shuffled-shards hot path, ISSUE 9)
+        hit = self.lease.get_entry(parent, name)
+        if hit is not None:
+            if hit == LeaseCache.NEGATIVE:
+                return errno.ENOENT, 0, Attr()
+            st, attr = self._attr_cached(hit)
+            if st == 0:
+                return 0, hit, attr
+            # dangling lease (inode vanished under the dentry): drop and
+            # revalidate through the engine
+            self.lease.invalidate_entry(parent, name)
+        st, ino, attr = self.do_lookup(
+            parent, name, hint_ino=self.lease.entry_hint(parent, name))
         if st:
+            if st == errno.ENOENT:
+                self.lease.put_negative(parent, name)
             return st, 0, Attr()
+        self.lease.put_entry(parent, name, ino)
+        self.lease.put_attr(ino, attr)
         return 0, ino, attr
 
     def resolve(self, ctx: Context, path: str) -> tuple[int, int, Attr]:
         """Walk an absolute path from root (reference pkg/fs path walk)."""
         ino = ROOT_INODE
-        st, attr = self.do_getattr(ino)
+        st, attr = self._attr_cached(ino)
         if st:
             return st, 0, Attr()
         for part in path.strip("/").split("/"):
@@ -514,15 +618,24 @@ class BaseMeta(interface.Meta):
         return 0, ino, attr
 
     def getattr(self, ctx: Context, ino: int) -> tuple[int, Attr]:
+        self._throttle(ctx)
         cached = self.of.attr(ino)
+        if cached is not None:
+            return 0, cached
+        cached = self.lease.get_attr(ino)
         if cached is not None:
             return 0, cached
         st, attr = self.do_getattr(ino)
         if st == 0:
+            # of.update only on a REAL fetch: refreshing the open-file
+            # TTL from a lease hit would extend its staleness bound
+            # beyond the openfile contract
             self.of.update(ino, attr)
+            self.lease.put_attr(ino, attr)
         return st, attr
 
     def setattr(self, ctx: Context, ino: int, flags: int, attr: Attr) -> tuple[int, Attr]:
+        self._throttle(ctx)
         st, cur = self.do_getattr(ino)
         if st:
             return st, Attr()
@@ -562,6 +675,7 @@ class BaseMeta(interface.Meta):
         rdev: int = 0,
         path: bytes = b"",
     ) -> tuple[int, int, Attr]:
+        self._throttle(ctx)
         st = self.check_name(name)
         if st:
             return st, 0, Attr()
@@ -595,6 +709,7 @@ class BaseMeta(interface.Meta):
         return self.do_readlink(ino)
 
     def unlink(self, ctx, parent, name, skip_trash=False) -> int:
+        self._throttle(ctx)
         st = self.check_name(name)
         if st:
             return st
@@ -607,10 +722,14 @@ class BaseMeta(interface.Meta):
                 # the victim's nlink/ctime changed: a hardlink sibling
                 # must not keep serving its open-file cached attr
                 self.of.invalidate(ino)
-            self._note_change(("e", parent, bytes(name)), ("a", parent))
+            # the victim's ("a", ino) rides along so peers drop hardlink
+            # siblings' attr leases too, not just the dentry
+            self._note_change(("e", parent, bytes(name)), ("a", parent),
+                              *((("a", ino),) if ino else ()))
         return st
 
     def rmdir(self, ctx, parent, name, skip_trash=False) -> int:
+        self._throttle(ctx)
         if name == b"." :
             return errno.EINVAL
         if name == b"..":
@@ -624,6 +743,7 @@ class BaseMeta(interface.Meta):
         return st
 
     def rename(self, ctx, psrc, nsrc, pdst, ndst, flags=0) -> tuple[int, int, Attr]:
+        self._throttle(ctx)
         st = self.check_name(ndst)
         if st:
             return st, 0, Attr()
@@ -646,6 +766,7 @@ class BaseMeta(interface.Meta):
         return st, ino, attr
 
     def link(self, ctx, ino, parent, name) -> tuple[int, Attr]:
+        self._throttle(ctx)
         st = self.check_name(name)
         if st:
             return st, Attr()
@@ -659,16 +780,23 @@ class BaseMeta(interface.Meta):
         return st, attr
 
     def readdir(self, ctx, ino, want_attr: bool = False) -> tuple[int, list[Entry]]:
+        self._throttle(ctx)
         st = self.access(ctx, ino, MODE_MASK_R)
         if st:
             return st, []
         st, entries = self.do_readdir(ctx, ino, want_attr)
         if st:
             return st, []
-        st2, attr = self.do_getattr(ino)
+        if want_attr and self.lease.enabled:
+            # readdirplus primes the attr leases: the stat-after-list
+            # pattern (every dataloader epoch) then serves from the cache
+            for e in entries:
+                if e.attr.full:
+                    self.lease.put_attr(e.inode, e.attr)
+        st2, attr = self._attr_cached(ino)
         if st2 == 0:
             entries.insert(0, Entry(inode=ino, name=b".", attr=attr))
-            st3, pattr = self.do_getattr(attr.parent or ino)
+            st3, pattr = self._attr_cached(attr.parent or ino)
             entries.insert(
                 1, Entry(inode=attr.parent or ino, name=b"..", attr=pattr if st3 == 0 else Attr(typ=TYPE_DIRECTORY))
             )
@@ -676,9 +804,16 @@ class BaseMeta(interface.Meta):
 
     # -- open-file lifecycle ----------------------------------------------
     def open(self, ctx, ino, flags) -> tuple[int, Attr]:
+        self._throttle(ctx)
+        # open() is the openfile cache's revalidation point: of.open's
+        # content-change detection (mtime/length vs the cached attr)
+        # drops stale chunk lists, so it must see a REAL fetch — a
+        # lease-served attr here would hide a peer's write for the lease
+        # TTL *plus* the openfile expire window
         st, attr = self.do_getattr(ino)
         if st:
             return st, Attr()
+        self.lease.put_attr(ino, attr)
         if attr.typ != TYPE_FILE:
             return errno.EPERM, Attr()
         if ctx.check_permission:
@@ -830,13 +965,19 @@ class BaseMeta(interface.Meta):
     def setxattr(self, ctx, ino, name: bytes, value: bytes, flags: int = 0) -> int:
         if not name:
             return errno.EINVAL
-        return self.do_setxattr(ino, name, value, flags)
+        st = self.do_setxattr(ino, name, value, flags)
+        if st == 0:
+            self.lease.invalidate_attr(ino)  # ctime moved
+        return st
 
     def listxattr(self, ctx, ino) -> tuple[int, list[bytes]]:
         return self.do_listxattr(ino)
 
     def removexattr(self, ctx, ino, name: bytes) -> int:
-        return self.do_removexattr(ino, name)
+        st = self.do_removexattr(ino, name)
+        if st == 0:
+            self.lease.invalidate_attr(ino)
+        return st
 
     # -- admin / tools -----------------------------------------------------
     def statfs(self, ctx) -> tuple[int, int, int, int]:
@@ -896,8 +1037,10 @@ class BaseMeta(interface.Meta):
         removed = 0
         if attr.typ != TYPE_DIRECTORY:
             st, vino = self.do_unlink(ctx, parent, name, skip_trash)
-            if st == 0 and vino:
-                self.of.invalidate(vino)
+            if st == 0:
+                if vino:
+                    self.of.invalidate(vino)
+                self._note_change(("e", parent, bytes(name)), ("a", parent))
             return st, (1 if st == 0 else 0)
         # stack holds (parent, name, ino, expanded); a dir is deleted only
         # after its expanded children have been processed
@@ -908,6 +1051,7 @@ class BaseMeta(interface.Meta):
                 st = self.do_rmdir(ctx, p, n, skip_trash)
                 if st:
                     return st, removed
+                self._note_change(("e", p, bytes(n)), ("a", p))
                 removed += 1
                 continue
             stack.append((p, n, i, True))
@@ -923,6 +1067,7 @@ class BaseMeta(interface.Meta):
                         return st, removed
                     if vino:
                         self.of.invalidate(vino)
+                    self._note_change(("e", i, bytes(e.name)), ("a", i))
                     removed += 1
         return 0, removed
 

@@ -29,6 +29,11 @@ class OpenFiles:
         self.expire = expire
         self._files: dict[int, _OpenFile] = {}
         self._lock = threading.Lock()
+        # invalidation fan-out: BaseMeta hooks the lease cache here so
+        # every existing of.invalidate site (including the ones inside
+        # engine transactions) also drops the meta-level attr lease
+        # (ISSUE 9) — called OUTSIDE the lock below.
+        self.on_invalidate = None
 
     @staticmethod
     def _content_changed(old: Attr, new: Attr) -> bool:
@@ -117,6 +122,9 @@ class OpenFiles:
                     of.chunks.pop(indx, None)
 
     def invalidate(self, ino: int) -> None:
+        cb = self.on_invalidate
+        if cb is not None:
+            cb(ino)
         with self._lock:
             of = self._files.get(ino)
             if of is not None:

@@ -199,13 +199,115 @@ class _RedisTxn(KVTxn):
                 return
 
 
+class _WriteInReadTxn(Exception):
+    """A simple_txn closure tried to write: rerun it under the full
+    WATCH-backed transaction (read closures are pure, so the rerun is
+    safe)."""
+
+
+class _ReadTxn(KVTxn):
+    """Read-only transaction for `simple_txn`: plain GET/MGET, no WATCH,
+    no UNWATCH — a point read is ONE round trip instead of the write
+    path's two — and routable to a replica connection (ISSUE 9).
+
+    Replica reads are guarded by the volume change-epoch: every committed
+    write transaction bumps the `!epoch` counter inside its MULTI/EXEC
+    and raises this client's floor from the commit reply, so the floor
+    covers the client's OWN writes exactly (read-your-own-writes across
+    the replica boundary — a create must never come back ENOENT from a
+    lagging replica).  The first read of a transaction pipelines
+    `GET !epoch` with its own MGET (no extra round trip); a replica whose
+    applied epoch trails the floor demotes the whole transaction to the
+    primary.  The connection choice is pinned for the transaction, so a
+    scan + gets closure never mixes replica and primary snapshots.
+    """
+
+    def __init__(self, client: "RedisKV"):
+        self._client = client
+        self._cache: dict[bytes, Optional[bytes]] = {}
+        self._conn: Optional[RespConnection] = None
+
+    def _ensure_conn(self, first_cmd: Optional[tuple] = None):
+        """Pick and pin the connection, riding the epoch guard on
+        `first_cmd`'s pipeline when the replica is a candidate.  Returns
+        first_cmd's reply (or None when called without one)."""
+        from .cache import _REPLICA_READS, _REPLICA_STALE
+
+        cl = self._client
+        if self._conn is None and cl.replica_host is not None:
+            try:
+                conn = cl._replica_conn()
+                if first_cmd is not None:
+                    conn.send((b"GET", cl.EPOCH_KEY), first_cmd)
+                    raw = conn.read_reply()
+                    reply = conn.read_reply()
+                else:
+                    raw = conn.execute(b"GET", cl.EPOCH_KEY)
+                    reply = None
+                if cl._epoch_of(raw) >= cl._epoch_floor:
+                    _REPLICA_READS.inc()
+                    self._conn = conn
+                    return reply
+                _REPLICA_STALE.inc()  # lagging: demote to the primary
+            except MetaNetworkError:
+                cl._drop_replica_conn()
+        if self._conn is None:
+            self._conn = cl._conn()
+        if first_cmd is None:
+            return None
+        self._conn.send(first_cmd)
+        return self._conn.read_reply()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self.gets(key)[0]
+
+    def gets(self, *keys):
+        missing = [k for k in keys if k not in self._cache]
+        if missing:
+            vals = self._ensure_conn(tuple([b"MGET"] + missing))
+            for k, v in zip(missing, vals):
+                self._cache[k] = v
+        return [self._cache[k] for k in keys]
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise _WriteInReadTxn
+
+    def delete(self, key: bytes) -> None:
+        raise _WriteInReadTxn
+
+    def scan(self, begin, end, keys_only=False, limit=-1):
+        self._ensure_conn()
+        conn = self._conn
+        names = self._client._range(conn, begin, end)
+        vals: dict[bytes, bytes] = {}
+        if not keys_only and names:
+            conn.send(tuple([b"MGET"] + names))
+            for k, v in zip(names, conn.read_reply()):
+                vals[k] = v
+        n = 0
+        for k in names:
+            v = b"" if keys_only else vals.get(k)
+            if v is None:
+                continue
+            yield (k, v)
+            n += 1
+            if limit >= 0 and n >= limit:
+                return
+
+
 class RedisKV(TKVClient):
     """TKVClient over the Redis protocol (multi-host capable)."""
 
     name = "redis"
 
     def __init__(self, addr: str):
-        # addr: host[:port][/db]
+        # addr: host[:port][/db][?replica=host[:port]]
+        replica = ""
+        if "?" in addr:
+            addr, query = addr.split("?", 1)
+            for part in query.split("&"):
+                if part.startswith("replica="):
+                    replica = part[len("replica="):]
         host, port, db = "127.0.0.1", 6379, 0
         if "/" in addr:
             addr, dbs = addr.rsplit("/", 1)
@@ -219,6 +321,14 @@ class RedisKV(TKVClient):
                 host = addr
         self.host, self.port, self.db = host or "127.0.0.1", port, db
         self._local = threading.local()
+        # read-replica routing (ISSUE 9): WATCH-backed txns stay pinned to
+        # the primary; _ReadTxn point reads go to the replica while its
+        # applied change-epoch has caught up with this client's floor
+        self.replica_host: Optional[str] = None
+        self.replica_port: int = 0
+        self._epoch_floor = 0
+        if replica:
+            self.configure_replica(replica)
         self.execute(b"PING")  # fail fast on a bad address
 
     # -- connections (one per thread, like SqliteKV) -----------------------
@@ -241,6 +351,61 @@ class RedisKV(TKVClient):
             conn.close()
             self._local.conn = None
 
+    # -- read replica (ISSUE 9) --------------------------------------------
+    # The volume change-epoch: every committed write transaction bumps
+    # this counter inside its MULTI/EXEC, so it advances with the
+    # mutation stream itself (replicated in order with it).  The commit
+    # reply raises the local floor, which is exactly the
+    # read-your-own-writes bound a replica read must satisfy.
+    EPOCH_KEY = b"!epoch"
+
+    def configure_replica(self, addr: str) -> None:
+        """Route read-only transactions to `host[:port]` (same db). The
+        primary remains the truth for every WATCH-backed transaction and
+        non-txn command."""
+        host, port = addr, self.port
+        if ":" in addr:
+            host, ps = addr.rsplit(":", 1)
+            port = int(ps)
+        self.replica_host, self.replica_port = host or "127.0.0.1", port
+        # prime the floor from the primary's CURRENT epoch: a read-only
+        # client (the dataloader case) never writes, so without this its
+        # floor would stay 0 and a still-syncing/lagging replica would
+        # pass the guard — serving ENOENT for files that exist
+        try:
+            self.advance_epoch(
+                self._epoch_of(self.execute(b"GET", self.EPOCH_KEY)))
+        except MetaNetworkError:
+            pass  # primary unreachable: the PING/first op will surface it
+
+    def advance_epoch(self, v: int) -> None:
+        """Monotonically raise the replica-read floor to an epoch this
+        client has observed on the primary."""
+        if v and v > self._epoch_floor:
+            self._epoch_floor = v
+
+    @staticmethod
+    def _epoch_of(raw) -> int:
+        if not raw:
+            return 0
+        try:
+            return int(raw)
+        except ValueError:
+            return int.from_bytes(raw, "big", signed=True)
+
+    def _replica_conn(self) -> RespConnection:
+        conn = getattr(self._local, "rconn", None)
+        if conn is None:
+            conn = RespConnection(self.replica_host, self.replica_port, self.db)
+            self._local.rconn = conn
+        return conn
+
+    def _drop_replica_conn(self) -> None:
+        conn = getattr(self._local, "rconn", None)
+        if conn is not None:
+            conn.close()
+            self._local.rconn = None
+
     # Commands execute() may transparently re-send after a network error:
     # re-running any of these converges to the same end state. Anything not
     # listed (a hypothetical INCR/APPEND) fails fast instead, because the
@@ -262,6 +427,30 @@ class RedisKV(TKVClient):
 
     def in_txn(self) -> bool:
         return getattr(self._local, "tx", None) is not None
+
+    def simple_txn(self, fn):
+        """Read-mostly transaction on the cheap path: no WATCH (a point
+        read is ONE round trip, with no trailing UNWATCH), replica-routable
+        (ISSUE 9).  A closure that unexpectedly writes reruns under the
+        full WATCH-backed txn — read closures are pure, so that is safe."""
+        active = getattr(self._local, "tx", None)
+        if active is not None:
+            return fn(active)  # nested: join the enclosing transaction
+        for attempt in range(1 + self._NET_RETRIES):
+            tx = _ReadTxn(self)
+            self._local.tx = tx
+            try:
+                return fn(tx)
+            except _WriteInReadTxn:
+                break  # writer closure: run it under the real txn below
+            except MetaNetworkError:
+                self._drop_conn()
+                self._drop_replica_conn()
+                if attempt >= self._NET_RETRIES:
+                    raise
+            finally:
+                self._local.tx = None
+        return self.txn(fn)
 
     # -- range helper ------------------------------------------------------
     @staticmethod
@@ -326,6 +515,11 @@ class RedisKV(TKVClient):
                     for k in adds:
                         zadd += [b"0", k]
                     cmds.append(tuple(zadd))
+                # the epoch bump rides the transaction itself, queued LAST
+                # (its value is EXEC's final reply): commit order and
+                # epoch order can never diverge, and the reply raises this
+                # client's replica-read floor (read-your-own-writes)
+                cmds.append((b"INCRBY", self.EPOCH_KEY, b"1"))
                 cmds.append((b"EXEC",))
                 conn.send(*cmds)
                 # send() raising means EXEC (the pipeline tail) never fully
@@ -334,6 +528,10 @@ class RedisKV(TKVClient):
                 committing = True
                 replies = [conn.read_reply() for _ in cmds]
                 if replies[-1] is not None:
+                    exec_replies = replies[-1]
+                    if isinstance(exec_replies, list) and exec_replies \
+                            and isinstance(exec_replies[-1], int):
+                        self.advance_epoch(exec_replies[-1])
                     return result  # committed
                 last = ConflictError(f"txn conflict (attempt {attempt})")
             except MetaNetworkError as e:
@@ -460,3 +658,4 @@ class RedisKV(TKVClient):
         if conn is not None:
             conn.close()
             self._local.conn = None
+        self._drop_replica_conn()

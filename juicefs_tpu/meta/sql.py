@@ -181,6 +181,9 @@ class SQLMeta(BaseMeta):
 
     F_UNLCK, F_RDLCK, F_WRLCK = 2, 0, 1
     _QUOTA_HINT_TTL = 1.0
+    # the invalidation table + invalSeq counter are the per-volume change
+    # feed the lease cache requires (ISSUE 9)
+    supports_inval_feed = True
 
     def __init__(self, path: str, addr: str = ""):
         super().__init__(addr or f"sql://{path}")
@@ -612,7 +615,9 @@ class SQLMeta(BaseMeta):
         return out
 
     # ---- namespace ---------------------------------------------------------
-    def do_lookup(self, parent: int, name: bytes) -> tuple[int, int, Attr]:
+    def do_lookup(self, parent: int, name: bytes, hint_ino: int = 0) -> tuple[int, int, Attr]:
+        # hint_ino is accepted for interface parity with the KV engine's
+        # batched lookup; an in-process SQL read has no round trips to save
         def fn(cur):
             typ, ino = self._get_edge(cur, parent, name)
             if ino == 0:

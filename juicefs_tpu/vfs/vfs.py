@@ -150,7 +150,11 @@ class VFS:
                 sp = NULL_SPAN if internal else tracer.span("vfs", __name)
                 self._op_depth.d = 1
                 t0 = _time.perf_counter()
-                with sp:
+                # tenant tagging of meta ops (ISSUE 9): EVERY vfs op runs
+                # under the request uid's tenant scope, so the per-tenant
+                # meta-op limiter and the DRR fairness queues attribute
+                # lookups/getattrs — not just block I/O — to the real user
+                with sp, tenant_scope(getattr(ctx, "uid", 0)):
                     try:
                         out = __orig(ctx, *a, **kw)
                     finally:

@@ -60,6 +60,22 @@ QOS_EXPECTED = {
     "juicefs_qos_throttle_wait_seconds",
     "juicefs_qos_throttled_bytes",
 }
+META_CACHE_PREFIX = "juicefs_meta_cache_"
+META_CACHE_EXPECTED = {
+    # lease cache + replica routing (ISSUE 9, meta/cache.py + redis_kv.py)
+    "juicefs_meta_cache_hits",
+    "juicefs_meta_cache_misses",
+    "juicefs_meta_cache_invalidates",
+    "juicefs_meta_cache_lease_expired",
+    "juicefs_meta_cache_replica_reads",
+    "juicefs_meta_cache_replica_stale",
+}
+META_THROTTLE_PREFIX = "juicefs_meta_throttle_"
+META_THROTTLE_EXPECTED = {
+    # per-tenant meta-op token buckets (ISSUE 9, --meta-op-limit)
+    "juicefs_meta_throttle_waits",
+    "juicefs_meta_throttle_wait_seconds",
+}
 
 
 def populate_registry() -> None:
@@ -75,6 +91,7 @@ def populate_registry() -> None:
     import juicefs_tpu.chunk.parallel       # noqa: F401  fetch_inflight gauge
     import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
     import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
+    import juicefs_tpu.meta.cache           # noqa: F401  lease cache + throttle
     import juicefs_tpu.metric.trace         # noqa: F401  stage rollup histogram
     import juicefs_tpu.object.metered       # noqa: F401  per-backend op meters
     import juicefs_tpu.object.resilient     # noqa: F401  retry/hedge/breaker
@@ -138,6 +155,9 @@ def run(files: list[SourceFile]) -> list[Finding]:
         + lint_pinned(INGEST_PREFIX, INGEST_EXPECTED, "ingest")
         + lint_pinned(QOS_PREFIX, QOS_EXPECTED, "qos")
         + lint_pinned(COMPRESS_PREFIX, COMPRESS_EXPECTED, "compress")
+        + lint_pinned(META_CACHE_PREFIX, META_CACHE_EXPECTED, "meta-cache")
+        + lint_pinned(META_THROTTLE_PREFIX, META_THROTTLE_EXPECTED,
+                      "meta-throttle")
     )
     return [Finding("", 0, "metric-registry", p) for p in problems]
 

@@ -205,15 +205,72 @@ def run_compress_seam(files: list[SourceFile]) -> list[Finding]:
     return findings
 
 
+def run_meta_cache_seam(files: list[SourceFile]) -> list[Finding]:
+    """VFS attr reads must route through the meta cache layer (ISSUE 9):
+    a bare ``do_getattr``/``do_lookup`` from vfs/ bypasses the lease
+    cache AND the per-tenant throttle, silently reverting the hot stat
+    path to one engine round trip per call — which no functional test
+    catches (results are identical, only the round trips regress).  The
+    cache layer itself must stay wired: BaseMeta.getattr/lookup consult
+    ``lease`` or the whole layer is dead code."""
+    findings: list[Finding] = []
+    base_sf = None
+    saw_pkg = False
+    for sf in files:
+        saw_pkg = saw_pkg or sf.rel.startswith("juicefs_tpu/")
+        rel = _pkg_rel(sf)
+        if rel == "meta/base.py":
+            base_sf = sf
+        if not rel.startswith("vfs/") or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("do_getattr", "do_lookup")):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "meta-cache-seam",
+                    f"bare {node.func.attr} from vfs/ bypasses the meta "
+                    "lease cache and the per-tenant throttle — call "
+                    "meta.getattr/meta.lookup",
+                ))
+    if base_sf is not None and base_sf.tree is not None:
+        for fn_name in ("getattr", "lookup"):
+            fn = None
+            for node in ast.walk(base_sf.tree):
+                if isinstance(node, ast.ClassDef) and node.name == "BaseMeta":
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef) \
+                                and item.name == fn_name:
+                            fn = item
+            if fn is None or not any(
+                isinstance(n, ast.Attribute) and n.attr == "lease"
+                for n in ast.walk(fn)
+            ):
+                findings.append(Finding(
+                    base_sf.rel, fn.lineno if fn else 0, "meta-cache-seam",
+                    f"BaseMeta.{fn_name} never consults the lease cache — "
+                    "the meta cache layer is disconnected",
+                ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/meta/base.py", 0, "meta-cache-seam",
+            "meta/base.py not found or unparseable",
+        ))
+    return findings
+
+
 def run(files: list[SourceFile]) -> list[Finding]:
     return (run_qos_seam(files) + run_resilience_seam(files)
-            + run_ingest_seam(files) + run_compress_seam(files))
+            + run_ingest_seam(files) + run_compress_seam(files)
+            + run_meta_cache_seam(files))
 
 
 PASS = Pass(
     name="seams",
-    rules=("qos-seam", "resilience-seam", "ingest-seam", "compress-seam"),
+    rules=("qos-seam", "resilience-seam", "ingest-seam", "compress-seam",
+           "meta-cache-seam"),
     run=run,
     doc="architecture seams: scheduler-only pools, resilience-wrapped "
-        "stores, ingest-guarded uploads, plane-routed compression",
+        "stores, ingest-guarded uploads, plane-routed compression, "
+        "cache-routed vfs attr reads",
 )

@@ -43,7 +43,6 @@ TEST_MAP = {
     "juicefs_tpu/meta/acl": ["tests/test_acl.py"],
     "juicefs_tpu/meta/kv": ["tests/test_meta.py", "tests/test_meta_random.py"],
     "juicefs_tpu/meta/sql": ["tests/test_meta.py", "tests/test_meta_random.py"],
-    "juicefs_tpu/meta/base": ["tests/test_meta.py"],
     "juicefs_tpu/vfs/cache": ["tests/test_vfs.py", "tests/test_fuse.py"],
     "juicefs_tpu/vfs/reader": ["tests/test_vfs.py", "tests/test_fsx.py"],
     "juicefs_tpu/vfs/writer": ["tests/test_vfs.py", "tests/test_fsx.py"],
@@ -80,6 +79,17 @@ TEST_MAP = {
                                      "-k", "not cli"],
     "juicefs_tpu/utils/lockwatch": ["tests/test_analysis.py",
                                     "-k", "watchdog"],
+    # ISSUE 9: meta lease cache + replica read routing. The coherence
+    # drills (stale-read bound, negative-entry invalidation, victim
+    # invalidation, replica-lag guard, TTL-0 passthrough) live in
+    # test_meta_cache.py; redis_kv mutants also face the dist suite's
+    # txn-conflict and reconnection drills.
+    "juicefs_tpu/meta/cache": ["tests/test_meta_cache.py"],
+    "juicefs_tpu/meta/base": ["tests/test_meta.py", "tests/test_meta_cache.py"],
+    "juicefs_tpu/meta/redis_kv": ["tests/test_meta_cache.py",
+                                  "tests/test_meta_dist.py"],
+    "juicefs_tpu/meta/redis_server": ["tests/test_meta_cache.py",
+                                      "tests/test_meta_dist.py"],
     # ISSUE 8: batched compression plane + adaptive elision bypass
     "juicefs_tpu/tpu/compress_batch": ["tests/test_compress_batch.py"],
     "juicefs_tpu/chunk/bypass": ["tests/test_ingest.py", "-k",
