@@ -1424,6 +1424,341 @@ def run_qos_bench(seconds: float = 3.0, block_kib: int = 512,
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_dataloader_bench(shards: int = 8, shard_mib: int = 32,
+                         block_mib: int = 1, clients: int = 2,
+                         epochs: int = 3, rtt: float = 0.04,
+                         read_kib: int = 512, lane_width: int = 64) -> dict:
+    """Dataloader-shaped read bench (ISSUE 11): a client fleet streams
+    shuffled shards for several epochs; measured per epoch with the
+    epoch-streaming read path ON vs OFF (OFF = the seed-era per-handle
+    window doubler capped at max_readahead).
+
+    The object backend is mem:// behind FaultyStore(latency=rtt): each
+    GET pays a real RTT at the object boundary, so aggregate throughput
+    is inflight-GET-bound — exactly the regime where the readahead window
+    (how many blocks the PREFETCH class keeps in flight) is the lever.
+    (mem, not file: this container's single core makes 9p file reads the
+    bottleneck otherwise, and the RTT regime is what a real object store
+    looks like from a dataloader.)
+    """
+    import random
+    import threading
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.meta.context import Context
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.object.fault import FaultyStore
+    from juicefs_tpu.qos import Scheduler
+    from juicefs_tpu.vfs import ROOT_INO, VFS, VFSConfig
+
+    bs = block_mib << 20
+    shard_bytes = shard_mib << 20
+    ctx = Context(uid=0, gid=0, pid=1)
+    out: dict = {
+        "shards": shards, "shard_mib": shard_mib, "block_mib": block_mib,
+        "clients": clients, "epochs": epochs, "rtt_ms": rtt * 1e3,
+        "read_kib": read_kib, "lane_width": lane_width,
+    }
+
+    def one_mode(streaming: bool) -> dict:
+        meta = new_client("mem://")
+        meta.init(Format(name="dl", storage="mem", block_size=bs),
+                  force=False)
+        meta.new_session()
+        # write the dataset through a latency-free store (ingest is not
+        # what this bench measures), then read it through a fresh cold
+        # store whose every object GET pays the RTT
+        objects = create_storage("mem://")
+        wsched = Scheduler()
+        wstore = CachedStore(objects,
+                             ChunkConfig(block_size=bs, hedge=False,
+                                         scheduler=wsched))
+        wvfs = VFS(meta, wstore, VFSConfig())
+        blob = os.urandom(1 << 20)
+        inos = []
+        for s in range(shards):
+            st, ino, _a, fh = wvfs.create(ctx, ROOT_INO,
+                                          b"shard-%03d" % s, 0o644)
+            assert st == 0
+            pos = 0
+            while pos < shard_bytes:
+                assert wvfs.write(ctx, ino, fh, pos, blob) == 0
+                pos += len(blob)
+            assert wvfs.flush(ctx, ino, fh) == 0
+            wvfs.release(ctx, ino, fh)
+            inos.append(ino)
+        wvfs.close()
+        wstore.close()
+        wsched.close()
+
+        backend = FaultyStore(objects, latency=rtt)
+        gets = [0]
+        gets_mu = threading.Lock()
+        real_get = backend.get
+
+        def counting_get(key, off=0, limit=-1):
+            # download-lane workers call this concurrently: a bare
+            # `gets[0] += 1` loses increments (load/add/store race)
+            with gets_mu:
+                gets[0] += 1
+            return real_get(key, off, limit)
+        backend.get = counting_get
+        sched = Scheduler()
+        store = CachedStore(backend, ChunkConfig(
+            block_size=bs, cache_size=2 << 30, hedge=False,
+            max_download=lane_width, prefetch=4, scheduler=sched))
+        vfs = VFS(meta, store, VFSConfig(
+            max_readahead=8 << 20, streaming_read=streaming,
+            streaming_after=2 << 20, max_streaming=64 << 20))
+        mode = {"streaming": streaming, "epochs": []}
+        try:
+            for epoch in range(epochs):
+                rng = random.Random(1000 + epoch)
+                order = list(range(shards))
+                rng.shuffle(order)
+                assign = [order[c::clients] for c in range(clients)]
+                g0 = gets[0]
+                i0, w0, u0, d0 = store.prefetcher.counters()
+                from juicefs_tpu.metric import global_registry
+                hits_c = global_registry()._metrics[
+                    "juicefs_blockcache_hits"].labels("mem")
+                miss_c = global_registry()._metrics[
+                    "juicefs_blockcache_miss"].labels("mem")
+                h0, m0 = hits_c.value, miss_c.value
+                moved = [0] * clients
+                errs = []
+
+                def worker(c: int) -> None:
+                    try:
+                        for s in assign[c]:
+                            fr = vfs.reader.open(inos[s])
+                            pos = 0
+                            while pos < shard_bytes:
+                                st, data = fr.read(
+                                    ctx, pos, read_kib << 10)
+                                assert st == 0 and len(data) > 0
+                                moved[c] += len(data)
+                                pos += len(data)
+                    except Exception as e:  # pragma: no cover
+                        errs.append(e)
+                t0 = time.perf_counter()
+                threads = [threading.Thread(target=worker, args=(c,),
+                                            daemon=True)
+                           for c in range(clients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                if errs:
+                    raise errs[0]
+                i1, w1, u1, d1 = store.prefetcher.counters()
+                issued, used = i1 - i0, u1 - u0
+                mode["epochs"].append({
+                    "epoch": epoch,
+                    "gibs": round(sum(moved) / wall / (1 << 30), 3),
+                    "wall_s": round(wall, 3),
+                    "object_gets": gets[0] - g0,
+                    "prefetch": {
+                        "issued": issued, "warmed": w1 - w0,
+                        "used": used, "dropped": d1 - d0,
+                        "used_ratio": round(used / issued, 3)
+                        if issued else None,
+                    },
+                    "tiers": {
+                        "mem_hits": int(hits_c.value - h0),
+                        "mem_miss": int(miss_c.value - m0),
+                    },
+                })
+            mode["readahead"] = vfs.reader.stats()
+        finally:
+            vfs.close()
+            store.close()
+            sched.close()
+        return mode
+
+    out["on"] = one_mode(True)
+    out["off"] = one_mode(False)
+    cold_on = out["on"]["epochs"][0]["gibs"]
+    cold_off = out["off"]["epochs"][0]["gibs"]
+    out["cold_epoch_speedup"] = round(cold_on / cold_off, 2) \
+        if cold_off else None
+    out["ring_drill"] = run_ring_warm_drill()
+    return out
+
+
+def run_ring_warm_drill(shards: int = 8, shard_mib: int = 4,
+                        block_kib: int = 512) -> dict:
+    """2-member cache-group drill (ISSUE 11 acceptance): epoch N's reads
+    + ring-aware warm placement leave every block cached ring-locally, so
+    epoch N+1 — with the shard assignment SWAPPED between the members —
+    serves with ZERO object GETs (counter-asserted) through local cache +
+    the peer rung."""
+    import shutil
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from juicefs_tpu.cache import CacheGroup, PeerBlockServer
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.meta.context import Context
+    from juicefs_tpu.metric import global_registry
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.qos import Scheduler
+    from juicefs_tpu.vfs import ROOT_INO, VFS, VFSConfig
+
+    bs = block_kib << 10
+    shard_bytes = shard_mib << 20
+    ctx = Context(uid=0, gid=0, pid=1)
+    base = tempfile.mkdtemp(prefix="jfs-ring-")
+    meta_url = f"sqlite3://{base}/meta.db"
+    out: dict = {"members": 2, "shards": shards, "shard_mib": shard_mib,
+                 "block_kib": block_kib}
+    try:
+        wmeta = new_client(meta_url)
+        wmeta.init(Format(name="ring", storage="file", block_size=bs),
+                   force=False)
+        wmeta.new_session()
+        wsched = Scheduler()
+        wstore = CachedStore(create_storage(f"file://{base}/blob"),
+                             ChunkConfig(block_size=bs, hedge=False,
+                                         scheduler=wsched))
+        wvfs = VFS(wmeta, wstore, VFSConfig())
+        blob = os.urandom(1 << 20)
+        inos = []
+        for s in range(shards):
+            st, ino, _a, fh = wvfs.create(ctx, ROOT_INO,
+                                          b"shard-%03d" % s, 0o644)
+            pos = 0
+            while pos < shard_bytes:
+                wvfs.write(ctx, ino, fh, pos, blob[:shard_bytes - pos])
+                pos += min(len(blob), shard_bytes - pos)
+            wvfs.flush(ctx, ino, fh)
+            wvfs.release(ctx, ino, fh)
+            inos.append(ino)
+        wvfs.close()
+        wstore.close()
+        wsched.close()
+        wmeta.close_session()
+
+        gets = [0]
+        gets_mu = threading.Lock()
+
+        def member(tag: str):
+            backend = create_storage(f"file://{base}/blob")
+            real_get = backend.get
+
+            def counting_get(key, off=0, limit=-1):
+                with gets_mu:  # both members' workers share the counter
+                    gets[0] += 1
+                return real_get(key, off, limit)
+            backend.get = counting_get
+            m = new_client(meta_url)
+            m.new_session()
+            sched = Scheduler()
+            store = CachedStore(backend, ChunkConfig(
+                block_size=bs, cache_size=1 << 30, hedge=False,
+                max_download=16, prefetch=4, scheduler=sched))
+            vfs = VFS(m, store, VFSConfig(
+                max_readahead=4 << 20, streaming_read=True,
+                streaming_after=1 << 20, max_streaming=32 << 20))
+            srv = PeerBlockServer(store, group="dl")
+            addr = srv.start()
+            return {"tag": tag, "meta": m, "sched": sched, "store": store,
+                    "vfs": vfs, "srv": srv, "addr": addr}
+
+        A, B = member("A"), member("B")
+        peers = {A["addr"]: 1, B["addr"]: 1}
+        for mb in (A, B):
+            mb["store"].cache_group = CacheGroup(
+                "dl", self_addr=mb["addr"], static_peers=dict(peers))
+
+        def read_shards(mb, which) -> int:
+            n = 0
+            for s in which:
+                fr = mb["vfs"].reader.open(inos[s])
+                pos = 0
+                while pos < shard_bytes:
+                    st, data = fr.read(ctx, pos, 512 << 10)
+                    assert st == 0 and len(data) > 0
+                    n += len(data)
+                    pos += len(data)
+            return n
+
+        def epoch(assign_a, assign_b) -> dict:
+            g0 = gets[0]
+            t0 = time.perf_counter()
+            moved = [0, 0]
+            ta = threading.Thread(
+                target=lambda: moved.__setitem__(
+                    0, read_shards(A, assign_a)), daemon=True)
+            tb = threading.Thread(
+                target=lambda: moved.__setitem__(
+                    1, read_shards(B, assign_b)), daemon=True)
+            ta.start(); tb.start(); ta.join(); tb.join()
+            # settle: let both members' prefetch stages (incl. peer warm
+            # hints) drain before the next epoch is measured
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if (A["store"].prefetcher.outstanding == 0
+                        and B["store"].prefetcher.outstanding == 0):
+                    break
+                time.sleep(0.05)
+            return {"gib": round(sum(moved) / (1 << 30), 3),
+                    "wall_s": round(time.perf_counter() - t0, 3),
+                    "object_gets": gets[0] - g0}
+
+        reg = global_registry()
+        hints_c = reg._metrics["juicefs_cache_group_warm_hints"]
+        peer_hits_c = reg._metrics["juicefs_cache_group_peer_hits"]
+        hints0, phits0 = hints_c.value, peer_hits_c.value
+        half = shards // 2
+        out["epoch_n"] = epoch(range(half), range(half, shards))
+        out["warm_hints"] = int(hints_c.value - hints0)
+        phits_mid = peer_hits_c.value
+        out["epoch_n1"] = epoch(range(half, shards), range(half))
+        out["epoch_n1"]["peer_hits"] = int(peer_hits_c.value - phits_mid)
+        for mb in (A, B):
+            mb["vfs"].close()
+            mb["srv"].stop()
+            mb["store"].close()
+            mb["sched"].close()
+            mb["meta"].close_session()
+        return out
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def main_dataloader(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataloader", action="store_true")
+    ap.add_argument("--dl-shards", type=int, default=8)
+    ap.add_argument("--dl-shard-mib", type=int, default=32)
+    ap.add_argument("--dl-clients", type=int, default=2)
+    ap.add_argument("--dl-epochs", type=int, default=3)
+    ap.add_argument("--dl-rtt-ms", type=float, default=40.0)
+    args, _ = ap.parse_known_args(argv)
+    res = run_dataloader_bench(
+        shards=args.dl_shards, shard_mib=args.dl_shard_mib,
+        clients=args.dl_clients, epochs=args.dl_epochs,
+        rtt=args.dl_rtt_ms / 1e3)
+    cold = res["on"]["epochs"][0]
+    print(json.dumps({
+        "metric": "dataloader_epoch_read",
+        "value": cold["gibs"],
+        "unit": "GiB/s aggregate (cold epoch, streaming on; "
+                "acceptance >= 2x streaming-off)",
+        "vs_off": res["cold_epoch_speedup"],
+        "prefetch_used_ratio": cold["prefetch"]["used_ratio"],
+        "ring_epoch_n1_gets": res["ring_drill"]["epoch_n1"]["object_gets"],
+        "dataloader": res,
+    }))
+    return 0
+
+
 def main_qos(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--qos", action="store_true")
@@ -1498,4 +1833,6 @@ if __name__ == "__main__":
         sys.exit(main_qos())
     if "--meta-scale" in sys.argv:
         sys.exit(main_meta_scale())
+    if "--dataloader" in sys.argv:
+        sys.exit(main_dataloader())
     sys.exit(main())

@@ -64,6 +64,11 @@ _PEER_HIST = _reg.histogram(
     "Peer block GET latency (successful fetches)",
     ("group",),
 )
+_WARM_HINTS = _reg.counter(
+    "juicefs_cache_group_warm_hints",
+    "Warm hints sent to ring owners (a non-owned block's prefetch "
+    "delegated instead of a redundant local object GET)",
+)
 
 
 class GroupPeer:
@@ -159,6 +164,29 @@ class GroupPeer:
                 f"peer {self.addr}: served {echoed!r} for {key!r}"
             )
         return body
+
+    def warm(self, key: str) -> bool:
+        """Ask this peer to warm `key` into ITS cache (no bytes move to
+        the caller).  The peer routes the hint through its own PREFETCH
+        stage, so it is bounded and sheddable there; 202 = accepted."""
+        resp = None
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request("POST", "/warm/" + key,
+                             headers={"Content-Length": "0"})
+                resp = conn.getresponse()
+                resp.read()  # drain: keep the keep-alive socket usable
+                break
+            except (http.client.CannotSendRequest, http.client.BadStatusLine,
+                    BrokenPipeError, ConnectionResetError):
+                self._drop_connection()
+                if attempt:
+                    raise
+            except Exception:
+                self._drop_connection()
+                raise
+        return resp.status in (200, 202)
 
     def close(self) -> None:
         self._drop_connection()
@@ -312,6 +340,46 @@ class CacheGroup:
                 # as misses would show a fake 0% hit rate during rollout.
                 _MISSES.inc()
         return None
+
+    # -- ring-aware warm placement (ISSUE 11) -------------------------------
+    def warm(self, key: str) -> bool:
+        """Hint the ring owner of `key` to warm it into ITS cache.  Used
+        by the prefetch stage for non-owned blocks: the owner pays the
+        one object GET for the whole group and later reads take the peer
+        rung.  No size travels with the hint — block keys pin their own
+        bsize and the owner re-derives it.  Fire-and-forget semantics —
+        NEVER raises, never moves bytes to this member; False = no owner
+        reachable (the block will simply warm on demand)."""
+        try:
+            self.refresh()
+            owner = self.ring.owner(key)
+            if owner is None or owner == self.self_addr:
+                return False  # empty ring / self-owned: nothing to hint
+            with self._mu:
+                peer = self._peers.get(owner)
+            if peer is None or not peer.breaker.allow():
+                return False
+            try:
+                ok = peer.warm(key)
+            except Exception as e:
+                _ERRORS.labels("transient").inc()
+                peer.breaker.record_failure()
+                logger.warning("peer %s warm %s: %s", owner, key, e)
+                return False
+            if not ok:
+                # the peer answered but refused (5xx/400): that is a sick
+                # peer for the warm path — the breaker must see it, or a
+                # permanently erroring owner eats one HTTP RTT per
+                # non-owned prefetch forever
+                _ERRORS.labels("transient").inc()
+                peer.breaker.record_failure()
+                return False
+            peer.breaker.record_success()
+            _WARM_HINTS.inc()
+            return True
+        except Exception:
+            logger.exception("cache-group warm %s degraded", key)
+            return False
 
     # -- observability ------------------------------------------------------
     def health(self) -> dict:

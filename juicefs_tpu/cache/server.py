@@ -7,6 +7,12 @@ bytes — the same dependency posture as the sync cluster manager):
     HEAD /block/{key}   presence probe: size + digest headers, no body
     GET  /ring          membership/identity snapshot (debugging, and the
                         target of peer-breaker half-open probes)
+    POST /warm/{key}    ring-aware warm hint (ISSUE 11): enqueue `key` on
+                        THIS member's prefetch stage (PREFETCH class,
+                        bounded, sheddable) so the ring owner fills its
+                        own cache from the object store; 202 = accepted.
+                        No request body is honored — a peer can ask this
+                        node to warm a block, never to store peer bytes.
 
 Every block response carries `X-Block-Crc32` (crc32 of the payload) so a
 client can reject a wrong-block serve during membership churn — a peer
@@ -15,8 +21,10 @@ corrupt or mismatched payload must never enter the reader's cache.
 
 Serves from the DiskCache/MemCache raw tier AND from writeback staging
 (`_pending_staged`): a block a peer wrote but has not uploaded yet is
-exactly the block the object store cannot serve.  Strictly read-only —
-peers can never mutate each other's caches.
+exactly the block the object store cannot serve.  Peers can never write
+data into each other's caches — the only mutation a peer can cause is a
+warm hint, which makes this node fetch its OWN verified copy from the
+object store through its bounded prefetch stage.
 """
 
 from __future__ import annotations
@@ -87,6 +95,11 @@ _SERVE_MISSES = _reg.counter(
     "juicefs_cache_group_serve_misses",
     "Peer block requests this node could not serve (not cached here)",
 )
+_WARM_REQS = _reg.counter(
+    "juicefs_cache_group_warm_requests",
+    "Warm hints accepted from peers (enqueued on the local prefetch "
+    "stage; rejected malformed hints are not counted)",
+)
 
 
 class PeerBlockServer:
@@ -110,6 +123,31 @@ class PeerBlockServer:
             # spilled staged entries (past the RAM cap) re-read their file
             data = self.store._staged_lookup(key)
         return data
+
+    def _warm(self, key: str) -> bool:
+        """Ring-aware warm hint: enqueue `key` on the local prefetch
+        stage.  The block size rides in the key itself (block keys are
+        `{id}_{indx}_{bsize}`), so a hint can never make this node fetch
+        at a size the key does not pin.  Bounded + sheddable: a flood of
+        hints degrades to later demand reads, never to foreground work.
+
+        A hint for a key THIS node's ring view does not place here is
+        absorbed (202, no enqueue): during membership churn two members
+        can each believe the other owns a key, and enqueueing it would
+        make `_prefetch_block` forward the hint straight back — a
+        self-sustaining ping-pong per key for as long as the views
+        diverge."""
+        from ..chunk.cached_store import parse_block_key
+
+        parsed = parse_block_key(key)
+        if parsed is None or parsed[2] <= 0:
+            return False  # only well-formed block keys; no path games
+        group = getattr(self.store, "cache_group", None)
+        if group is not None and not group.owns(key):
+            return True  # stale-ring hint: absorb, never bounce it back
+        _WARM_REQS.inc()
+        self.store.prefetcher.fetch((key, parsed[2]))
+        return True
 
     def ring_view(self) -> dict:
         group = getattr(self.store, "cache_group", None)
@@ -168,6 +206,34 @@ class PeerBlockServer:
             def do_HEAD(self):  # noqa: N802
                 if self.path.startswith("/block/"):
                     self._block(send_body=False)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802
+                # drain any body first: a keep-alive connection with an
+                # unread body would desync the next request on the socket
+                try:
+                    ln = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    self.send_error(400)
+                    self.close_connection = True
+                    return
+                if ln > 1 << 20:
+                    # oversized body: a partial drain would desync the
+                    # socket — refuse and drop the connection instead
+                    self.send_error(413)
+                    self.close_connection = True
+                    return
+                if ln:
+                    self.rfile.read(ln)
+                if self.path.startswith("/warm/"):
+                    key = self.path[len("/warm/"):].split("?", 1)[0]
+                    if server._warm(key):
+                        self.send_response(202)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                    else:
+                        self.send_error(400)
                 else:
                     self.send_error(404)
 

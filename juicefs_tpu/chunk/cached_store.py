@@ -416,20 +416,37 @@ class CachedStore:
             logger.warning("alias resolve %s: %s", key, e)
             return None
 
+    @property
+    def prefetcher(self) -> Prefetcher:
+        """The speculative-warming stage (vfs readahead feedback reads
+        its counters; benches settle on its outstanding count)."""
+        return self._fetcher
+
     def _prefetch_block(self, key_size) -> bool:
         """Returns True only when this call actually warmed the block
         (Prefetcher credits juicefs_prefetch_used from that)."""
         key, bsize = key_size
-        if self.degraded and self.cache_group is None:
+        group = self.cache_group
+        if self.degraded and group is None:
             # outage: warming would only burn EIO fast-fails (with a cache
             # group the peer rung may still warm us, so keep trying)
             return False
-        if self.cache.load(key, count_miss=False) is None:
-            try:
-                self._load_block(key, bsize)
-                return True
-            except (NotFoundError, BreakerOpenError):
-                pass
+        if self.cache.load(key, count_miss=False) is not None:
+            return False
+        if group is not None and not group.owns(key):
+            # ring-aware warm placement (ISSUE 11): a block another member
+            # owns warms THERE, not here — a local object GET would put a
+            # second copy of the same bytes in the group and pay the
+            # backend twice for it.  The hint enqueues on the owner's own
+            # PREFETCH stage (bounded, sheddable); this member's later
+            # demand read takes the peer rung in _load_block.
+            group.warm(key)
+            return False
+        try:
+            self._load_block(key, bsize)
+            return True
+        except (NotFoundError, BreakerOpenError):
+            pass
         return False
 
     # -- public API (reference chunk.go:37-46 ChunkStore) ------------------
@@ -449,9 +466,13 @@ class CachedStore:
 
     def prefetch(self, sid: int, length: int, off: int = 0, size: int | None = None) -> None:
         """Warm the blocks of slice `sid` covering [off, off+size) via the
-        prefetch pool (used by the VFS readahead; reference prefetch.go)."""
+        prefetch pool (used by the VFS readahead; reference prefetch.go).
+        Already-cached blocks are skipped HERE (an index probe, no bytes
+        read even on the disk tier): issuing them would churn the queue
+        and dilute the used/issued ratio the readahead window feedback
+        steers by (ISSUE 11)."""
         for key, bsize in self._block_range(sid, length, off, size):
-            if bsize > 0:
+            if bsize > 0 and not self.cache.contains(key):
                 self._fetcher.fetch((key, bsize))
 
     def new_writer(self, sid: int) -> "WSlice":

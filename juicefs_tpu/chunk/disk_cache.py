@@ -156,6 +156,15 @@ class DiskCache:
             self._used += ondisk
         self._maybe_evict()
 
+    def contains(self, key: str) -> bool:
+        """Cheap membership probe against the in-memory index — no file
+        open, no payload read, no CRC, no hit/miss accounting (the
+        prefetch planner's skip check, ISSUE 11).  The index can lag the
+        disk contents across a restart scan; a false negative only costs
+        one redundant prefetch enqueue."""
+        with self._lock:
+            return key in self._index
+
     def load(self, key: str, count_miss: bool = True) -> Optional[bytes]:
         """count_miss semantics: see MemCache.load — speculative probes
         pass False so each real miss is counted once."""
@@ -355,6 +364,9 @@ class CacheManager:
 
     def cache(self, key, data):
         self._pick(key).cache(key, data)
+
+    def contains(self, key) -> bool:
+        return self._pick(key).contains(key)
 
     def load(self, key, count_miss: bool = True):
         return self._pick(key).load(key, count_miss)

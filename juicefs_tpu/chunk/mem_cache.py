@@ -53,6 +53,12 @@ class MemCache:
                 _EVICT_MEM.inc()
                 _EVICT_BYTES_MEM.inc(len(buf))
 
+    def contains(self, key: str) -> bool:
+        """Cheap membership probe (no bytes, no hit/miss accounting, no
+        recency bump): the prefetch planner's skip check (ISSUE 11)."""
+        with self._lock:
+            return key in self._data
+
     def load(self, key: str, count_miss: bool = True) -> Optional[bytes]:
         """count_miss=False marks a speculative probe whose miss will be
         re-checked (and counted) by the authoritative load — so one real

@@ -7,11 +7,15 @@ read it was meant to accelerate — and SHEDS them on a full class queue
 (the cheap outcome of an overdriven window is a later cache miss, not
 backpressure on the read path).
 
-Effectiveness accounting is unchanged: every accepted fetch counts as
-*issued*; when a later cache hit consumes a block this prefetcher warmed
-(the store calls `consumed()` on its hit paths), it counts as *used*.
-issued-vs-used is the readahead efficiency signal (a low ratio means the
-window wastes GETs).
+Effectiveness accounting: every accepted fetch counts as *issued*; a
+fetch that actually loaded a block (not already cached, object present)
+counts as *warmed*; when a later cache hit consumes a block this
+prefetcher warmed (the store calls `consumed()` on its hit paths), it
+counts as *used*.  Since ISSUE 11 the counters are ALSO kept per
+instance and fed back into readahead sizing: `FileReader` reads
+`counters()` deltas and stops growing (or shrinks) a window whose
+used/issued ratio shows the speculation is being wasted — the window
+doubler no longer grows blind.
 """
 
 from __future__ import annotations
@@ -34,6 +38,10 @@ _DROPPED = _reg.counter(
 )
 _USED = _reg.counter(
     "juicefs_prefetch_used", "Prefetched blocks later served from cache"
+)
+_WARMED = _reg.counter(
+    "juicefs_prefetch_warmed",
+    "Prefetch fetches that actually loaded a block (not already cached)",
 )
 _TR = global_tracer()
 _H_FETCH = stage_hist("chunk", "prefetch", "fetch")
@@ -63,6 +71,32 @@ class Prefetcher:
         self._pending: set[Hashable] = set()
         self._warmed: dict[Hashable, None] = {}  # insertion-ordered FIFO
         self._lock = threading.Lock()
+        # instance counters (the window-feedback signal, ISSUE 11): the
+        # process-global metrics aggregate every store; a FileReader
+        # sizing ITS window needs the owning store's ratio
+        self._n_issued = 0
+        self._n_warmed = 0
+        self._n_used = 0
+        self._n_dropped = 0
+
+    @property
+    def depth(self) -> int:
+        """Outstanding-fetch bound: the natural ceiling for a streaming
+        readahead window in blocks (enqueueing past it only sheds)."""
+        return self._depth
+
+    @property
+    def outstanding(self) -> int:
+        """Fetches issued but not yet finished (bench/test settling)."""
+        with self._lock:
+            return len(self._pending)
+
+    def counters(self) -> tuple[int, int, int, int]:
+        """(issued, warmed, used, dropped) cumulative for THIS instance.
+        Callers compute deltas between snapshots for a live ratio."""
+        with self._lock:
+            return (self._n_issued, self._n_warmed, self._n_used,
+                    self._n_dropped)
 
     def fetch(self, key: Hashable) -> None:
         if not self._enabled:
@@ -73,6 +107,7 @@ class Prefetcher:
                 return
             if len(self._pending) >= self._depth:
                 _DROPPED.inc()
+                self._n_dropped += 1
                 return
             self._pending.add(key)
         try:
@@ -88,8 +123,11 @@ class Prefetcher:
             _DROPPED.inc()
             with self._lock:
                 self._pending.discard(key)
+                self._n_dropped += 1
         else:
             _ISSUED.inc()
+            with self._lock:
+                self._n_issued += 1
 
     def consumed(self, key: Hashable) -> None:
         """A cache hit consumed this block; count it as prefetch-used if
@@ -99,6 +137,7 @@ class Prefetcher:
         with self._lock:
             if self._warmed.pop(key, 0) is None:
                 _USED.inc()
+                self._n_used += 1
 
     def close(self, timeout: Optional[float] = 10.0) -> None:
         """Stop warming: queued fetches are cancelled, in-flight ones are
@@ -124,7 +163,9 @@ class Prefetcher:
             # (already cached, object missing) must not inflate
             # juicefs_prefetch_used
             if did:
+                _WARMED.inc()
                 with self._lock:
+                    self._n_warmed += 1
                     self._warmed[key] = None
                     while len(self._warmed) > _WARMED_CAP:
                         self._warmed.pop(next(iter(self._warmed)))

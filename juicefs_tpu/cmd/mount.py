@@ -79,6 +79,17 @@ def add_parser(sub):
                         "auto-picks; the bound address is published in the "
                         "session info)")
     p.add_argument("--max-readahead", type=int, default=8, help="MiB")
+    p.add_argument("--no-streaming-read", action="store_true",
+                   help="disable the epoch-streaming read path (ISSUE 11): "
+                        "handles then keep the block-granularity window "
+                        "doubler capped at --max-readahead instead of "
+                        "escalating to file-granularity readahead")
+    p.add_argument("--streaming-after", type=int, default=16,
+                   help="MiB of sustained sequential reads before a "
+                        "handle escalates to streaming readahead")
+    p.add_argument("--max-streaming", type=int, default=64,
+                   help="MiB cap on a streaming handle's readahead window "
+                        "(also bounded by the prefetch queue depth)")
     p.add_argument("--attr-cache", type=float, default=1.0,
                    help="attr cache TTL seconds (reference --attr-cache)")
     p.add_argument("--entry-cache", type=float, default=1.0,
@@ -236,6 +247,9 @@ def serve(args) -> int:
         m,
         store,
         VFSConfig(readonly=args.readonly, max_readahead=args.max_readahead << 20,
+                  streaming_read=not args.no_streaming_read,
+                  streaming_after=args.streaming_after << 20,
+                  max_streaming=args.max_streaming << 20,
                   attr_timeout=args.attr_cache, entry_timeout=args.entry_cache,
                   dir_entry_timeout=args.dir_entry_cache),
         fmt,

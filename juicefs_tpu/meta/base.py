@@ -853,6 +853,34 @@ class BaseMeta(interface.Meta):
             self.of.cache_chunk(ino, indx, slices)
         return st, slices
 
+    def read_chunks(self, ino: int,
+                    indxs: list[int]) -> list[tuple[int, list[Slice]]]:
+        """Batched chunk reads (ISSUE 11): the readahead planner walks a
+        whole window in ONE engine round trip instead of one per chunk.
+        Open-file-cached chunks are served locally; only the misses hit
+        `do_read_chunks` (engines may override with a single txn)."""
+        out: dict[int, tuple[int, list[Slice]]] = {}
+        misses: list[int] = []
+        for indx in indxs:
+            cached = self.of.chunk(ino, indx)
+            if cached is not None:
+                out[indx] = (0, cached)
+            else:
+                misses.append(indx)
+        if misses:
+            for indx, (st, slices) in zip(
+                    misses, self.do_read_chunks(ino, misses)):
+                if st == 0:
+                    self.of.cache_chunk(ino, indx, slices)
+                out[indx] = (st, slices)
+        return [out[i] for i in indxs]
+
+    def do_read_chunks(self, ino: int,
+                       indxs: list[int]) -> list[tuple[int, list[Slice]]]:
+        """Engine hook for batched chunk reads; the default loops
+        do_read_chunk (kv overrides with one MGET txn)."""
+        return [self.do_read_chunk(ino, i) for i in indxs]
+
     def write_chunk(self, ino: int, indx: int, pos: int, slc: Slice) -> int:
         if indx < 0 or pos + slc.len > CHUNK_SIZE:
             return errno.EINVAL

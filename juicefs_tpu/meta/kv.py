@@ -1095,6 +1095,14 @@ class KVMeta(BaseMeta):
             return 0, []
         return 0, Slice.decode_list(raw)
 
+    def do_read_chunks(self, ino, indxs) -> list[tuple[int, list[Slice]]]:
+        """Readahead-planner batch (ISSUE 11): every chunk of the window
+        in ONE MGET txn — on a networked/replica engine that is one round
+        trip instead of len(indxs)."""
+        keys = [self._chunk_key(ino, i) for i in indxs]
+        raws = self.client.simple_txn(lambda tx: tx.gets(*keys))
+        return [(0, Slice.decode_list(raw) if raw else []) for raw in raws]
+
     def do_compact_chunk(self, ino: int, indx: int, snapshot: bytes, new_slice: Slice) -> int:
         """Replace the compacted prefix of a chunk's slice list with one
         merged slice (reference base.go:2009 compactChunk txn). `snapshot`

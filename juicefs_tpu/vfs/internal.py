@@ -238,6 +238,11 @@ class InternalFiles:
             # ring membership + per-peer breaker state (ISSUE 4: a dead
             # peer's open breaker must be observable here)
             out["cache_group"] = group.health()
+        # epoch-streaming read path (ISSUE 11): live window/streaming
+        # state plus the prefetch used/issued effectiveness counters
+        reader = getattr(self.vfs, "reader", None)
+        if reader is not None:
+            out["readahead"] = reader.stats()
         # unified I/O scheduler + bandwidth budget (ISSUE 6): lane/queue
         # occupancy per class and token-bucket levels
         sched = getattr(store, "scheduler", None)

@@ -54,6 +54,13 @@ MAX_SYMLINK = 4096
 class VFSConfig:
     readonly: bool = False
     max_readahead: int = 8 << 20
+    # epoch-streaming read path (ISSUE 11): a handle sustaining
+    # sequential progress past `streaming_after` bytes escalates from the
+    # block-granularity window doubler to file-granularity readahead
+    # capped at `max_streaming` (further bounded by the prefetch queue)
+    streaming_read: bool = True
+    streaming_after: int = 16 << 20
+    max_streaming: int = 64 << 20
     attr_timeout: float = 1.0
     entry_timeout: float = 1.0
     dir_entry_timeout: float = 1.0
@@ -75,7 +82,12 @@ class VFS:
         self.fmt = fmt
         self.handles = HandleTable()
         self.writer = DataWriter(meta, store)
-        self.reader = DataReader(meta, store, self.conf.max_readahead, writer=self.writer)
+        self.reader = DataReader(
+            meta, store, self.conf.max_readahead, writer=self.writer,
+            streaming=self.conf.streaming_read,
+            streaming_after=self.conf.streaming_after,
+            max_streaming=self.conf.max_streaming,
+        )
         self._append_lock = threading.Lock()
         # entry/attr TTL caches (vfs/cache.py): kernel-style caching for
         # every adapter; local mutations invalidate synchronously below

@@ -1044,3 +1044,68 @@ def test_watchdog_enabled_for_suite_and_factories_patched():
     assert isinstance(sf._lock, lockwatch.WatchedLock), sf._lock
     # and test-code locks stay raw
     assert not isinstance(threading.Lock(), lockwatch.WatchedLock)
+
+
+# ---------------------------------------------------------------------------
+# prefetch-seam (ISSUE 11): speculative warming stays on the PREFETCH stage
+
+_READER_DIRTY = """
+class FileReader:
+    def read(self, off, size):
+        self._readahead(off + size, 8)  # inline: planning on the read thread
+        return b""
+
+    def _readahead(self, off, size):
+        raw = self.dr.store._load_block("k", size)  # loads, not warms
+        data = self.dr.store.storage.get("k")
+"""
+
+_READER_CLEAN = """
+from ..qos import IOClass
+
+class FileReader:
+    def read(self, off, size):
+        self.dr.ppool.submit(self._readahead, off + size, 8)
+        return b""
+
+    def _readahead(self, off, size):
+        self.dr.store.prefetch(1, size, off, size)
+
+class DataReader:
+    def __init__(self, store):
+        self.ppool = store.scheduler.executor("slice", IOClass.PREFETCH)
+"""
+
+
+def test_prefetch_seam_inline_plan_and_loads_fire(tmp_path):
+    report = _run(tmp_path, {"vfs/reader.py": _READER_DIRTY})
+    msgs = [f.message for f in report.findings if f.rule == "prefetch-seam"]
+    assert any("invoked synchronously" in m for m in msgs), msgs
+    assert any("loads blocks" in m for m in msgs), msgs
+    assert any("seam is gone" in m for m in msgs), msgs
+    assert any("IOClass.PREFETCH" in m for m in msgs), msgs
+
+
+def test_prefetch_seam_submitted_plan_clean(tmp_path):
+    report = _run(tmp_path, {"vfs/reader.py": _READER_CLEAN})
+    assert not [f for f in report.findings if f.rule == "prefetch-seam"], \
+        report.findings
+
+
+def test_prefetch_seam_store_prefetch_must_not_load(tmp_path):
+    report = _run(tmp_path, {"chunk/cached_store.py": """
+class CachedStore:
+    def prefetch(self, sid, length, off=0, size=None):
+        for key, bsize in self._block_range(sid, length, off, size):
+            self._load_block(key, bsize)  # inline load on the caller
+"""})
+    msgs = [f.message for f in report.findings if f.rule == "prefetch-seam"]
+    assert any("loads inline" in m for m in msgs), msgs
+    assert any("Prefetcher.fetch" in m for m in msgs), msgs
+
+
+def test_prefetch_seam_real_tree_clean():
+    """The live package must satisfy its own seam."""
+    report = analyze(runtime=False)
+    assert not [f for f in report.findings if f.rule == "prefetch-seam"], \
+        [f.render() for f in report.findings]

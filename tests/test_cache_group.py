@@ -921,3 +921,96 @@ def test_warmup_cli_group_self_resolution(tmp_path):
             g2.close()
     finally:
         m1.close_session()
+
+
+# -- ring-aware warm placement (ISSUE 11) ------------------------------------
+
+def _owned_by(ring, addr, bs=BS, limit=4000):
+    """A (sid, key) whose single block the ring places on `addr`."""
+    for sid in range(1000, 1000 + limit):
+        k = block_key(sid, 0, bs)
+        if ring.owner(k) == addr:
+            return sid, k
+    raise AssertionError("no key landed on the target member")
+
+
+def test_warm_hint_fills_owner_not_sender(tmp_path):
+    """`CacheGroup.warm` makes the ring OWNER fetch its own copy; no
+    bytes ever land in the sender's cache."""
+    backend = create_storage("mem://")
+    A = CachedStore(backend, ChunkConfig(block_size=BS))
+    srv = PeerBlockServer(A, group="warm")
+    addr = srv.start()
+    B = CachedStore(backend, ChunkConfig(block_size=BS))
+    B.cache_group = CacheGroup("warm", self_addr="b-self:1",
+                               static_peers={addr: 1})
+    try:
+        sid, key = _owned_by(B.cache_group.ring, addr)
+        backend.put(key, b"w" * BS)
+        hints0 = _counter_value("juicefs_cache_group_warm_hints")
+        reqs0 = _counter_value("juicefs_cache_group_warm_requests")
+        assert B.cache_group.warm(key) is True
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if A.cache.load(key, count_miss=False) is not None:
+                break
+            time.sleep(0.02)
+        assert A.cache.load(key, count_miss=False) is not None, \
+            "owner never warmed the hinted block"
+        assert B.cache.load(key, count_miss=False) is None, \
+            "warm hint moved bytes to the sender"
+        assert _counter_value("juicefs_cache_group_warm_hints") == hints0 + 1
+        assert _counter_value("juicefs_cache_group_warm_requests") == reqs0 + 1
+    finally:
+        B.close()
+        srv.stop()
+        A.close()
+
+
+def test_prefetch_routes_non_owned_to_warm_hint(tmp_path):
+    """The prefetch stage consults the ring: a non-owned block's warm is
+    DELEGATED to the owner — the local member pays no object GET for it."""
+    backend = create_storage("mem://")
+    A = CachedStore(backend, ChunkConfig(block_size=BS))
+    srv = PeerBlockServer(A, group="route")
+    addr = srv.start()
+    B = CachedStore(backend, ChunkConfig(block_size=BS))
+    B.cache_group = CacheGroup("route", self_addr="b-self:1",
+                               static_peers={addr: 1})
+    try:
+        sid, key = _owned_by(B.cache_group.ring, addr)
+        backend.put(key, b"r" * BS)
+        gets = _spy_gets(backend)
+        B.prefetch(sid, BS)  # enqueue on B's prefetch stage
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if A.cache.load(key, count_miss=False) is not None:
+                break
+            time.sleep(0.02)
+        assert A.cache.load(key, count_miss=False) is not None
+        assert B.cache.load(key, count_miss=False) is None
+        # exactly ONE object GET for the whole group: the owner's fill
+        assert gets[0] == 1
+    finally:
+        B.close()
+        srv.stop()
+        A.close()
+
+
+def test_warm_endpoint_rejects_malformed_keys(tmp_path):
+    import http.client
+
+    A = CachedStore(create_storage("mem://"), ChunkConfig(block_size=BS))
+    srv = PeerBlockServer(A, group="bad")
+    addr = srv.start()
+    try:
+        host, _, port = addr.rpartition(":")
+        for path in ("/warm/../../etc/passwd", "/warm/notablockkey",
+                     "/warm/"):
+            conn = http.client.HTTPConnection(host, int(port), timeout=2)
+            conn.request("POST", path, headers={"Content-Length": "0"})
+            assert conn.getresponse().status == 400, path
+            conn.close()
+    finally:
+        srv.stop()
+        A.close()

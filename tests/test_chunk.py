@@ -241,7 +241,12 @@ def test_concurrent_readers_singleflight():
         return orig(key, off, limit)
 
     storage.get = counting_get
-    store = CachedStore(storage, ChunkConfig(block_size=1 << 16))
+    # hedge=False: this asserts SINGLEFLIGHT dedup (exactly one GET);
+    # with hedging on, the process-global mem-backend p95 — polluted
+    # by any earlier fast test — can drop below the 10ms sleep and a
+    # legitimate hedge duplicates the GET
+    store = CachedStore(storage, ChunkConfig(block_size=1 << 16,
+                                             hedge=False))
     data = os.urandom(65536)
     w = store.new_writer(41)
     w.write_at(data, 0)

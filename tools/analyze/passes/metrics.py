@@ -25,6 +25,29 @@ CACHE_GROUP_EXPECTED = {
     "juicefs_cache_group_served",
     "juicefs_cache_group_served_bytes",
     "juicefs_cache_group_serve_misses",
+    # ring-aware warm placement (ISSUE 11): hints sent / accepted
+    "juicefs_cache_group_warm_hints",
+    "juicefs_cache_group_warm_requests",
+}
+PREFETCH_PREFIX = "juicefs_prefetch_"
+PREFETCH_EXPECTED = {
+    # speculative-warming effectiveness (chunk/prefetch.py); used/issued
+    # is the readahead window feedback signal (ISSUE 11)
+    "juicefs_prefetch_issued",
+    "juicefs_prefetch_duplicates",
+    "juicefs_prefetch_dropped",
+    "juicefs_prefetch_used",
+    "juicefs_prefetch_warmed",
+}
+READAHEAD_PREFIX = "juicefs_readahead_"
+READAHEAD_EXPECTED = {
+    # epoch-streaming read path (ISSUE 11, vfs/reader.py)
+    "juicefs_readahead_plans",
+    "juicefs_readahead_plan_shed",
+    "juicefs_readahead_streaming",
+    "juicefs_readahead_epoch_warms",
+    "juicefs_readahead_window_bytes",
+    "juicefs_readahead_streaming_handles",
 }
 INGEST_PREFIX = "juicefs_ingest_"
 INGEST_EXPECTED = {
@@ -100,6 +123,7 @@ def populate_registry() -> None:
     import juicefs_tpu.qos.scheduler        # noqa: F401  scheduler classes
     import juicefs_tpu.tpu.compress_batch   # noqa: F401  compression plane
     import juicefs_tpu.tpu.pipeline         # noqa: F401  batch metrics
+    import juicefs_tpu.vfs.reader           # noqa: F401  readahead/streaming
     from juicefs_tpu.metric import register_process_metrics
 
     register_process_metrics()
@@ -158,6 +182,8 @@ def run(files: list[SourceFile]) -> list[Finding]:
         + lint_pinned(META_CACHE_PREFIX, META_CACHE_EXPECTED, "meta-cache")
         + lint_pinned(META_THROTTLE_PREFIX, META_THROTTLE_EXPECTED,
                       "meta-throttle")
+        + lint_pinned(PREFETCH_PREFIX, PREFETCH_EXPECTED, "prefetch")
+        + lint_pinned(READAHEAD_PREFIX, READAHEAD_EXPECTED, "readahead")
     )
     return [Finding("", 0, "metric-registry", p) for p in problems]
 

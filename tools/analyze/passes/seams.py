@@ -15,6 +15,10 @@ shim over these.
   routes through the batched compression plane — no bare
   ``compressor.compress`` calls, and ``_put_block`` must actually reach
   ``compress_plane.compress_one``.
+* ``prefetch-seam`` (ISSUE 11): speculative warming routes through the
+  ``Prefetcher`` at PREFETCH class — readahead planning is SUBMITTED,
+  never invoked on the read thread, and readahead/warm-hint paths never
+  load blocks or hit the object store at foreground class.
 """
 
 from __future__ import annotations
@@ -259,18 +263,151 @@ def run_meta_cache_seam(files: list[SourceFile]) -> list[Finding]:
     return findings
 
 
+# the methods that make up the speculative read path: they run at
+# PREFETCH class and must never be invoked synchronously by a read, nor
+# load blocks themselves (the Prefetcher owns the actual I/O)
+_SPECULATIVE_FNS = ("_readahead", "_warm_next_shard")
+_FOREGROUND_LOADS = ("_load_block", "new_reader")
+
+
+def _fn_defs(tree, names) -> list[ast.FunctionDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef) and n.name in names]
+
+
+def run_prefetch_seam(files: list[SourceFile]) -> list[Finding]:
+    """Speculative warming must route through the Prefetcher at PREFETCH
+    class (ISSUE 11).  A refactor that inlines `_readahead` back onto the
+    read thread, or loads blocks from a readahead/warm path, silently
+    moves speculative meta walks and object GETs onto foreground reads —
+    results stay identical, only the read-path latency contract breaks,
+    which no functional test catches."""
+    findings: list[Finding] = []
+    reader_sf = store_sf = server_sf = None
+    saw_pkg = False
+    for sf in files:
+        saw_pkg = saw_pkg or sf.rel.startswith("juicefs_tpu/")
+        rel = _pkg_rel(sf)
+        if rel == "vfs/reader.py":
+            reader_sf = sf
+        elif rel == "chunk/cached_store.py":
+            store_sf = sf
+        elif rel == "cache/server.py":
+            server_sf = sf
+    if reader_sf is not None and reader_sf.tree is not None:
+        # 1. planning is submitted, never called: any direct CALL of a
+        # speculative method runs the chunk-meta walk on the caller (the
+        # foreground read thread) — passing the method reference to an
+        # executor is an Attribute argument, not a Call, and stays legal
+        for node in ast.walk(reader_sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SPECULATIVE_FNS):
+                findings.append(Finding(
+                    reader_sf.rel, node.lineno, "prefetch-seam",
+                    f"{node.func.attr} invoked synchronously — readahead "
+                    "planning must be SUBMITTED at PREFETCH class "
+                    "(DataReader.ppool), never run on the read thread",
+                ))
+        # 2. speculative bodies only WARM (store.prefetch / fetcher
+        # .fetch); loading blocks there would run object GETs at the
+        # planner's own pace instead of the bounded sheddable queue
+        warms = False
+        for fn in _fn_defs(reader_sf.tree, _SPECULATIVE_FNS):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                holder = (getattr(node.func.value, "attr", None)
+                          or getattr(node.func.value, "id", None))
+                if attr in _FOREGROUND_LOADS \
+                        or (attr == "get" and holder == "storage"):
+                    findings.append(Finding(
+                        reader_sf.rel, node.lineno, "prefetch-seam",
+                        f"{fn.name} loads blocks ({holder or ''}"
+                        f".{attr}) — speculative paths may only enqueue "
+                        "on the prefetch stage (store.prefetch)",
+                    ))
+                if attr in ("prefetch", "fetch"):
+                    warms = True
+        if not warms:
+            findings.append(Finding(
+                reader_sf.rel, 0, "prefetch-seam",
+                "no speculative path ever reaches store.prefetch/"
+                "fetcher.fetch — the readahead seam is gone",
+            ))
+        # 3. the plan executor must exist at PREFETCH class
+        if not any(isinstance(n, ast.Attribute) and n.attr == "PREFETCH"
+                   for n in ast.walk(reader_sf.tree)):
+            findings.append(Finding(
+                reader_sf.rel, 0, "prefetch-seam",
+                "vfs/reader.py never references IOClass.PREFETCH — "
+                "readahead planning lost its class",
+            ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/vfs/reader.py", 0, "prefetch-seam",
+            "vfs/reader.py not found or unparseable",
+        ))
+    if store_sf is not None and store_sf.tree is not None:
+        # CachedStore.prefetch is the enqueue-only entry point: it must
+        # route through the Prefetcher, and never load inline
+        for node in ast.walk(store_sf.tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name == "CachedStore"):
+                continue
+            for item in node.body:
+                if not (isinstance(item, ast.FunctionDef)
+                        and item.name == "prefetch"):
+                    continue
+                calls = [n for n in ast.walk(item)
+                         if isinstance(n, ast.Call)
+                         and isinstance(n.func, ast.Attribute)]
+                findings.extend(
+                    Finding(store_sf.rel, c.lineno, "prefetch-seam",
+                            "CachedStore.prefetch loads inline "
+                            f"({c.func.attr}) — it may only enqueue on "
+                            "the Prefetcher")
+                    for c in calls if c.func.attr in _FOREGROUND_LOADS
+                    or (c.func.attr == "get"
+                        and getattr(c.func.value, "attr", None) == "storage")
+                )
+                if not any(c.func.attr == "fetch" for c in calls):
+                    findings.append(Finding(
+                        store_sf.rel, item.lineno, "prefetch-seam",
+                        "CachedStore.prefetch never reaches "
+                        "Prefetcher.fetch — the warming seam is gone",
+                    ))
+    if server_sf is not None and server_sf.tree is not None:
+        # peer warm hints enqueue on the local prefetch stage — serving
+        # them with a foreground load would let peers spend this member's
+        # foreground budget
+        for fn in _fn_defs(server_sf.tree, ("_warm",)):
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _FOREGROUND_LOADS):
+                    findings.append(Finding(
+                        server_sf.rel, node.lineno, "prefetch-seam",
+                        "peer warm hint loads inline — it must enqueue "
+                        "through the Prefetcher (PREFETCH class)",
+                    ))
+    return findings
+
+
 def run(files: list[SourceFile]) -> list[Finding]:
     return (run_qos_seam(files) + run_resilience_seam(files)
             + run_ingest_seam(files) + run_compress_seam(files)
-            + run_meta_cache_seam(files))
+            + run_meta_cache_seam(files) + run_prefetch_seam(files))
 
 
 PASS = Pass(
     name="seams",
     rules=("qos-seam", "resilience-seam", "ingest-seam", "compress-seam",
-           "meta-cache-seam"),
+           "meta-cache-seam", "prefetch-seam"),
     run=run,
     doc="architecture seams: scheduler-only pools, resilience-wrapped "
         "stores, ingest-guarded uploads, plane-routed compression, "
-        "cache-routed vfs attr reads",
+        "cache-routed vfs attr reads, prefetch-routed speculative reads",
 )

@@ -44,7 +44,12 @@ TEST_MAP = {
     "juicefs_tpu/meta/kv": ["tests/test_meta.py", "tests/test_meta_random.py"],
     "juicefs_tpu/meta/sql": ["tests/test_meta.py", "tests/test_meta_random.py"],
     "juicefs_tpu/vfs/cache": ["tests/test_vfs.py", "tests/test_fuse.py"],
-    "juicefs_tpu/vfs/reader": ["tests/test_vfs.py", "tests/test_fsx.py"],
+    # ISSUE 11: epoch-streaming read path — the window state machine,
+    # reorder tolerance, feedback gating and epoch hook are proven by
+    # test_reader.py; test_vfs keeps the end-to-end read semantics honest
+    "juicefs_tpu/vfs/reader": ["tests/test_reader.py", "tests/test_vfs.py"],
+    "juicefs_tpu/chunk/prefetch": ["tests/test_reader.py",
+                                   "tests/test_parallel_fetch.py"],
     "juicefs_tpu/vfs/writer": ["tests/test_vfs.py", "tests/test_fsx.py"],
     "juicefs_tpu/chunk/cached_store": ["tests/test_chunk.py",
                                        "tests/test_chaos.py",
