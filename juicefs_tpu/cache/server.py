@@ -142,12 +142,19 @@ class PeerBlockServer:
         parsed = parse_block_key(key)
         if parsed is None or parsed[2] <= 0:
             return False  # only well-formed block keys; no path games
-        group = getattr(self.store, "cache_group", None)
-        if group is not None and not group.owns(key):
-            return True  # stale-ring hint: absorb, never bounce it back
-        _WARM_REQS.inc()
-        self.store.prefetcher.fetch((key, parsed[2]))
-        return True
+        try:
+            group = getattr(self.store, "cache_group", None)
+            if group is not None and not group.owns(key):
+                return True  # stale-ring hint: absorb, never bounce it back
+            _WARM_REQS.inc()
+            self.store.prefetcher.fetch((key, parsed[2]))
+            return True
+        except Exception as e:
+            # a hint is advisory: an internal error must neither kill the
+            # handler thread nor desync the keep-alive socket — answer
+            # 400 (the sender's breaker sees a sick peer) and move on
+            logger.warning("warm hint %s degraded: %s", key, e)
+            return False
 
     def ring_view(self) -> dict:
         group = getattr(self.store, "cache_group", None)

@@ -65,8 +65,9 @@ def _sum_staging(fn) -> float:
     try:
         for s in list(_LIVE_STORES):
             total += fn(s)
-    except Exception:
-        pass  # racing a store teardown must never break a scrape
+    except Exception as e:
+        # racing a store teardown must never break a scrape
+        logger.debug("staging gauge raced a teardown: %s", e)
     return total
 
 
@@ -599,8 +600,8 @@ class CachedStore:
         if self.ingest is not None:
             try:
                 self.ingest.close()  # stops feeding the pool before shutdown
-            except Exception:
-                pass
+            except Exception as e:
+                logger.warning("ingest stage close failed: %s", e)
         self._pool.shutdown(wait=True)
         self._ingest_pool.shutdown(wait=True)
         self._replay_pool.shutdown(wait=True, timeout=60.0)
@@ -611,17 +612,17 @@ class CachedStore:
         if self.indexer is not None:
             try:
                 self.indexer.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.warning("indexer close failed: %s", e)
         if self.cache_group is not None:
             try:
                 self.cache_group.close()  # stop peer breaker probes
-            except Exception:
-                pass
+            except Exception as e:
+                logger.warning("cache-group close failed: %s", e)
         try:  # resilience resources (probe thread, abandon pool) only —
             self.storage.close()  # the inner store belongs to its owner
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning("storage close failed: %s", e)
         self.release_cache_locks()
 
     # -- staged-block bookkeeping (bounded RAM, ISSUE 5 satellite) ---------

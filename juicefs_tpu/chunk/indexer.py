@@ -55,8 +55,8 @@ def _queued_blocks() -> int:
     try:
         for ix in list(_LIVE_INDEXERS):
             total += ix._q.qsize()
-    except Exception:
-        pass
+    except Exception as e:
+        logger.debug("index queue gauge raced a teardown: %s", e)
     return total
 
 

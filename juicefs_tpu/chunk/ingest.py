@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import threading
 import weakref
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Optional
 
 from ..metric import global_registry
@@ -98,8 +98,8 @@ def _queued_blocks() -> int:
     try:
         for p in list(_LIVE_PIPELINES):
             total += p._batcher.qsize()
-    except Exception:
-        pass
+    except Exception as e:
+        logger.debug("ingest queue gauge raced a teardown: %s", e)
     return total
 
 
@@ -119,7 +119,7 @@ def _settle_future(fut: Future, exc=None) -> None:
             fut.set_result(None)
         else:
             fut.set_exception(exc)
-    except Exception:
+    except InvalidStateError:
         pass  # already resolved by the racing path: first writer wins
 
 
@@ -390,25 +390,42 @@ class IngestPipeline:
         _BLOCKS.inc()
         _BYTES.inc(len(raw))
         self.blocks += 1
-        gov = self.governor
-        if not closed and gov is not None:
-            verdict = gov.admit()
-            if verdict == ElisionGovernor.PROBE and self._hot is not None:
-                # free density probe: sampled-fp + memcmp on the writer
-                # thread (~µs), upload proceeds untouched below
-                gov.record(self._hot.probe(raw))
-            elif verdict == ElisionGovernor.PROBE:
-                verdict = ElisionGovernor.DEDUP  # no hot cache: real probe
-            if verdict != ElisionGovernor.DEDUP:
-                # bypass: sampled dup density is low — this block skips
-                # hash/lookup and rides the plain FOREGROUND upload
-                # pool, exactly the no-dedup write path (counted by the
-                # governor, not as a degrade)
-                return self._passthrough(key, raw, parent, fut, count=False)
-        if closed or not self._batcher.submit((key, raw, parent, fut, parsed)):
-            # hash plane saturated (or a racing close()): the write must
-            # not wait for dedup — and an item enqueued behind the CLOSE
-            # sentinel would never resolve its future
+        route = "dedup"
+        try:
+            gov = self.governor
+            if not closed and gov is not None:
+                verdict = gov.admit()
+                if verdict == ElisionGovernor.PROBE and self._hot is not None:
+                    # free density probe: sampled-fp + memcmp on the writer
+                    # thread (~µs), upload proceeds untouched below
+                    gov.record(self._hot.probe(raw))
+                elif verdict == ElisionGovernor.PROBE:
+                    verdict = ElisionGovernor.DEDUP  # no hot cache: real probe
+                if verdict != ElisionGovernor.DEDUP:
+                    # bypass: sampled dup density is low — this block skips
+                    # hash/lookup and rides the plain FOREGROUND upload
+                    # pool, exactly the no-dedup write path (counted by the
+                    # governor, not as a degrade)
+                    route = "bypass"
+            if route == "dedup" and (
+                    closed
+                    or not self._batcher.submit((key, raw, parent, fut,
+                                                 parsed))):
+                # hash plane saturated (or a racing close()): the write must
+                # not wait for dedup — and an item enqueued behind the CLOSE
+                # sentinel would never resolve its future
+                route = "degrade"
+        except Exception as e:
+            # dedup is advisory end to end: a broken governor/hot-cache/
+            # batcher must degrade THIS block to the plain upload, never
+            # fail the writer's submit (degrade-not-raise seam)
+            _ERRORS.inc()
+            self.errors += 1
+            logger.warning("ingest submit degraded to passthrough: %s", e)
+            route = "degrade"
+        if route == "bypass":
+            return self._passthrough(key, raw, parent, fut, count=False)
+        if route == "degrade":
             return self._passthrough(key, raw, parent, fut)
         return fut
 
@@ -441,9 +458,10 @@ class IngestPipeline:
             pool_fut = (pool or self.store._pool).submit(
                 self.store._put_or_stage, key, raw, parent
             )
-        except (RuntimeError, TimeoutError) as e:
-            # pool shut down mid-teardown, or qos backpressure timed out:
-            # the block's fate must reach the caller, not kill the worker
+        except Exception as e:
+            # pool shut down mid-teardown (RuntimeError), qos backpressure
+            # timed out (TimeoutError), or anything else: the block's fate
+            # must reach the caller, not kill the worker
             _settle_future(fut, e)
             return fut
 
@@ -504,8 +522,9 @@ class IngestPipeline:
                 import jax
 
                 packed = tuple(jax.device_put(a) for a in packed)
-            except Exception:
-                pass  # host arrays still work, just without the sharing
+            except Exception as e:
+                # host arrays still work, just without the shared H2D
+                logger.debug("device_put sharing degraded: %s", e)
         if raws:
             with _TR.span("chunk", "ingest", stage="hash",
                           hist=_H_HASH) as sp:
@@ -767,8 +786,10 @@ class IngestPipeline:
                 self.race_collapsed += 1
                 try:
                     self.store.storage.delete(leader[0])
-                except Exception:
-                    pass  # a leaked duplicate object; gc collects it
+                except Exception as e:
+                    # a leaked duplicate object; gc --dedup collects it
+                    logger.warning("race-collapsed object %s not "
+                                   "deleted: %s", leader[0], e)
             if results is not None:
                 followers.extend((digest, m) for m in members[1:])
             else:

@@ -25,6 +25,9 @@ from typing import Callable, Hashable, Optional
 
 from ..metric import global_registry
 from ..metric.trace import global_tracer, stage_hist
+from ..utils import get_logger
+
+logger = get_logger("chunk.prefetch")
 
 _reg = global_registry()
 _ISSUED = _reg.counter(
@@ -110,16 +113,24 @@ class Prefetcher:
                 self._n_dropped += 1
                 return
             self._pending.add(key)
+        fut = None
         try:
             fut = self._ex.submit(self._run_one, key)
-        except (RuntimeError, TimeoutError):
+        except Exception as e:
             # RuntimeError: racing close() — the owner no longer wants
             # warming.  TimeoutError: scheduler backpressure leaked out of
-            # a demoted submit — speculative warming must never stall or
-            # fail the caller, and the key must leave _pending either way
-            fut = None
+            # a demoted submit.  Anything else is equally a shed:
+            # speculative warming must never stall or fail the caller,
+            # and the key must leave _pending on EVERY failure or it is
+            # deduplicated forever and never fetched again
+            # (claim-rollback: the reservation must not leak)
+            logger.debug("prefetch submit shed %s: %s", key, e)
+            with self._lock:
+                self._pending.discard(key)
         if fut is None:
-            # scheduler shed it (PREFETCH class queue full) or closed
+            # scheduler shed it (full PREFETCH queue -> submit returned
+            # None), racing close, or the submit raised above (the
+            # re-discard is an idempotent no-op then)
             _DROPPED.inc()
             with self._lock:
                 self._pending.discard(key)
@@ -169,8 +180,12 @@ class Prefetcher:
                     self._warmed[key] = None
                     while len(self._warmed) > _WARMED_CAP:
                         self._warmed.pop(next(iter(self._warmed)))
-        except Exception:
-            pass
+        except Exception as e:
+            # speculative load failed (backend hiccup past the breaker
+            # guard): the cost is a later demand miss, but it must be
+            # visible — a silently failing prefetch plane looks exactly
+            # like a working one from the read path
+            logger.debug("prefetch of %s degraded: %s", key, e)
         finally:
             with self._lock:
                 self._pending.discard(key)

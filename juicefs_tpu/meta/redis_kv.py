@@ -25,7 +25,7 @@ import threading
 import time
 from typing import Iterator, Optional
 
-from ..utils import get_logger
+from ..utils import get_logger, txnwatch
 from .tkv_client import ConflictError, KVTxn, TKVClient, next_key
 
 logger = get_logger("meta.redis_kv")
@@ -127,6 +127,10 @@ class _RedisTxn(KVTxn):
         self._conn = conn
         self._writes: dict[bytes, Optional[bytes]] = {}
         self._read_cache: dict[bytes, Optional[bytes]] = {}
+        # txnwatch read-set: scans are not in _read_cache, but the rerun
+        # harness needs everything the closure OBSERVED to judge whether
+        # divergent writes mean impurity or just a concurrent writer
+        self._scan_log: list = []
 
     def get(self, key: bytes) -> Optional[bytes]:
         if key in self._writes:
@@ -185,6 +189,11 @@ class _RedisTxn(KVTxn):
         else:
             for k in names:
                 merged[k] = b""
+        if txnwatch.active():
+            # read-set recording for the rerun harness only: a sorted
+            # full copy per scan is pure waste on production listings
+            self._scan_log.append(
+                (begin, end, tuple(sorted((k, merged[k]) for k in merged))))
         for k, v in self._writes.items():
             if begin <= k < end:
                 merged[k] = v
@@ -490,23 +499,38 @@ class RedisKV(TKVClient):
             committing = False
             try:
                 conn = self._conn()
-                tx = _RedisTxn(self, conn)
-                self._local.tx = tx
-                try:
-                    result = fn(tx)
-                except BaseException:
-                    self._unwatch_quiet(conn)
-                    raise
-                finally:
-                    self._local.tx = None
-                if tx._discarded or not tx._writes:
+
+                # txn-rerun harness seam: under JUICEFS_TXN_RERUN the
+                # closure runs twice against fresh write buffers (reads
+                # re-WATCH the same keys, so the conflict guard is
+                # unchanged); redis is registered RACY — a concurrent
+                # writer between the runs triggers a triple-check, not
+                # a false violation
+                def run_once():
+                    tx = _RedisTxn(self, conn)
+                    self._local.tx = tx
+                    try:
+                        r = fn(tx)
+                    except BaseException:
+                        self._unwatch_quiet(conn)
+                        raise
+                    finally:
+                        self._local.tx = None
+                    # 4th element = the read set: divergent writes only
+                    # count as impurity when both runs read the same state
+                    return (r, tx._writes, tx._discarded,
+                            (tx._read_cache, tuple(tx._scan_log)))
+
+                result, writes, discarded = txnwatch.double_run(
+                    "redis", fn, run_once)
+                if discarded or not writes:
                     self._unwatch_quiet(conn)
                     return result
                 cmds: list[tuple] = [(b"MULTI",)]
-                adds = [k for k, v in tx._writes.items() if v is not None]
-                dels = [k for k, v in tx._writes.items() if v is None]
+                adds = [k for k, v in writes.items() if v is not None]
+                dels = [k for k, v in writes.items() if v is None]
                 for k in adds:
-                    cmds.append((b"SET", k, tx._writes[k]))
+                    cmds.append((b"SET", k, writes[k]))
                 if dels:
                     cmds.append(tuple([b"DEL"] + dels))
                     cmds.append(tuple([b"ZREM", IDX_KEY] + dels))

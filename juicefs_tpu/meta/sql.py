@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Iterator, Optional
 
-from ..utils import get_logger
+from ..utils import get_logger, txnwatch
 from . import acl as acl_mod
 from . import interface
 from .base import BaseMeta
@@ -240,13 +240,33 @@ class SQLMeta(BaseMeta):
                     self._tlocal.in_txn = True
                     msgs: list = []
                     self._tlocal.msgs = msgs
-                    result = fn(conn.cursor())
+                    # txn-rerun harness seam: the doubled first run rolls
+                    # back to a savepoint and the recorded mutating-SQL
+                    # streams are compared; queued notifications are
+                    # cleared per run so a rerun cannot double them
+                    tw = txnwatch.active()
+                    if tw:
+                        conn.execute("SAVEPOINT txnwatch")
+
+                    def run_once():
+                        del msgs[:]
+                        cur = txnwatch.RecordingCursor(conn.cursor()) \
+                            if tw else conn.cursor()
+                        r = fn(cur)
+                        return (r, tuple(cur.log) if tw else None, False)
+
+                    result, _w, _d = txnwatch.double_run(
+                        "sql", fn, run_once,
+                        (lambda: conn.execute("ROLLBACK TO txnwatch"))
+                        if tw else None)
                     st = result if isinstance(result, int) else (
                         result[0] if isinstance(result, tuple) and result else 0
                     )
                     if errno_abort and isinstance(st, int) and st:
                         conn.execute("ROLLBACK")
                         return result
+                    if tw:
+                        conn.execute("RELEASE txnwatch")
                     conn.execute("COMMIT")
                     committed = (result, msgs)
                 except sqlite3.OperationalError as e:
@@ -289,7 +309,16 @@ class SQLMeta(BaseMeta):
             try:
                 conn.execute("BEGIN")
                 try:
-                    return fn(conn.cursor())
+                    # txn-rerun harness seam: read closures double under
+                    # the snapshot (race-free); nothing to reset — the
+                    # whole transaction rolls back below either way
+                    def run_once():
+                        r = fn(conn.cursor())
+                        return r, None, False
+
+                    result, _w, _d = txnwatch.double_run(
+                        "sql-read", fn, run_once)
+                    return result
                 finally:
                     conn.execute("ROLLBACK")
             except sqlite3.OperationalError as e:
@@ -2283,6 +2312,10 @@ class SQLMeta(BaseMeta):
         recs: list[tuple[bytes, bytes]] = []
 
         def fn(cur):
+            # reset-first: _rtxn reruns the closure on a sqlite BUSY
+            # retry, and an append-only accumulator would double every
+            # record in the dump (txn-purity contract)
+            del recs[:]
             row = cur.execute("SELECT value FROM setting WHERE name='format'").fetchone()
             if row:
                 recs.append((b"setting", bytes(row[0])))

@@ -20,7 +20,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
-from ..utils import get_logger
+from ..utils import get_logger, txnwatch
 
 logger = get_logger("meta.tkv")
 
@@ -195,15 +195,24 @@ class MemKV(TKVClient):
         if active is not None:
             return fn(active)
         with self._lock:
-            tx = _MemTxn(self)
-            self._local.tx = tx
-            try:
-                result = fn(tx)
-            finally:
-                self._local.tx = None
-            if tx._discarded:
+            # txn-rerun harness seam: under JUICEFS_TXN_RERUN the closure
+            # runs twice against fresh buffers (the lock serializes, so
+            # the comparison is race-free) and the second run's writes
+            # commit; inactive, double_run is a plain single call
+            def run_once():
+                tx = _MemTxn(self)
+                self._local.tx = tx
+                try:
+                    r = fn(tx)
+                finally:
+                    self._local.tx = None
+                return r, tx._writes, tx._discarded
+
+            result, writes, discarded = txnwatch.double_run(
+                "memkv", fn, run_once)
+            if discarded:
                 return result
-            for k, v in tx._writes.items():
+            for k, v in writes.items():
                 if v is None:
                     if k in self._data:
                         del self._data[k]
@@ -235,6 +244,11 @@ class MemKV(TKVClient):
 
 
 class _SqliteTxn(KVTxn):
+    # txnwatch write recorder: the harness compares the ordered
+    # set/delete stream between the doubled runs (writes here go
+    # straight to the connection, so there is no buffer to diff)
+    _log = None
+
     def __init__(self, conn: sqlite3.Connection):
         self._conn = conn
 
@@ -259,12 +273,16 @@ class _SqliteTxn(KVTxn):
         return [found.get(bytes(k)) for k in keys]
 
     def set(self, key, value):
+        if self._log is not None:
+            self._log.append(("set", bytes(key), bytes(value)))
         self._conn.execute(
             "INSERT INTO kv(k, v) VALUES(?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
             (key, bytes(value)),
         )
 
     def delete(self, key):
+        if self._log is not None:
+            self._log.append(("del", bytes(key)))
         self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
 
     def scan(self, begin, end, keys_only=False, limit=-1):
@@ -323,9 +341,29 @@ class SqliteKV(TKVClient):
                 try:
                     conn.execute("BEGIN IMMEDIATE")
                     self._local.in_txn = True
-                    tx = _SqliteTxn(conn)
-                    result = fn(tx)
-                    conn.execute("ROLLBACK" if tx._discarded else "COMMIT")
+                    # txn-rerun harness seam: writes land on the
+                    # connection directly, so the doubled first run is
+                    # discarded by rolling back to a savepoint and the
+                    # recorded set/delete streams are compared
+                    tw = txnwatch.active()
+                    if tw:
+                        conn.execute("SAVEPOINT txnwatch")
+
+                    def run_once():
+                        tx = _SqliteTxn(conn)
+                        if tw:
+                            tx._log = []
+                        r = fn(tx)
+                        return (r, tuple(tx._log) if tw else None,
+                                tx._discarded)
+
+                    result, _w, discarded = txnwatch.double_run(
+                        "sqlite3", fn, run_once,
+                        (lambda: conn.execute("ROLLBACK TO txnwatch"))
+                        if tw else None)
+                    if tw:
+                        conn.execute("RELEASE txnwatch")
+                    conn.execute("ROLLBACK" if discarded else "COMMIT")
                     return result
                 except sqlite3.OperationalError as e:
                     try:
@@ -357,20 +395,47 @@ class SqliteKV(TKVClient):
                 conn.execute("BEGIN")
                 self._local.in_txn = True
                 before = conn.total_changes
-                tx = _SqliteTxn(conn)
+                # txn-rerun harness seam: read closures double too (the
+                # BEGIN snapshot makes the comparison race-free); a
+                # writer closure's first run rolls back to the savepoint
+                tw = txnwatch.active()
+                if tw:
+                    conn.execute("SAVEPOINT txnwatch")
+                last_tx: dict = {}
+
+                def run_once():
+                    tx = _SqliteTxn(conn)
+                    if tw:
+                        tx._log = []
+                    last_tx["tx"] = tx
+                    r = fn(tx)
+                    return (r, tuple(tx._log) if tw else None,
+                            tx._discarded)
+
                 ok = False
                 try:
-                    result = fn(tx)
+                    result, _w, _d = txnwatch.double_run(
+                        "sqlite3", fn, run_once,
+                        (lambda: conn.execute("ROLLBACK TO txnwatch"))
+                        if tw else None)
                     ok = True
                     return result
                 finally:
                     self._local.in_txn = False
                     # same contract as txn(): an exception or discard()
                     # must never commit partial writes; a caller that
-                    # (unexpectedly) wrote and returned cleanly commits
+                    # (unexpectedly) wrote and returned cleanly commits.
+                    # (total_changes is monotonic, so a rolled-back first
+                    # run still marks `wrote` — commit then covers the
+                    # surviving second run's writes.)
                     wrote = conn.total_changes != before
+                    if tw and ok:
+                        conn.execute("RELEASE txnwatch")
+                    tx = last_tx.get("tx")
                     conn.execute(
-                        "COMMIT" if (ok and wrote and not tx._discarded)
+                        "COMMIT"
+                        if (ok and wrote and tx is not None
+                            and not tx._discarded)
                         else "ROLLBACK"
                     )
             except sqlite3.OperationalError:

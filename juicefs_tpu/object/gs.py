@@ -228,8 +228,11 @@ class GSStorage(ObjectStorage):
         for o in list(self.list_all(upload_id + "/")):
             try:
                 self.delete(o.key)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort cleanup on the abort retry path: a leaked
+                # temp component must at least be traceable
+                logger.warning("abort_upload: stale part %s not "
+                               "deleted: %s", o.key, e)
 
     def limits(self) -> dict:
         return {"min_part_size": 1 << 20, "max_part_count": 32}

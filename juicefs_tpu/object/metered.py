@@ -14,7 +14,10 @@ import errno as _errno
 
 from ..metric import global_registry
 from ..metric.trace import global_tracer
+from ..utils import get_logger
 from .interface import NotFoundError, ObjectStorage
+
+logger = get_logger("object.metered")
 
 _reg = global_registry()
 _DUR = _reg.histogram(
@@ -43,8 +46,9 @@ class MeteredStorage(ObjectStorage):
         self._inner = inner
         try:
             backend = inner.string().split("://", 1)[0] or type(inner).__name__
-        except Exception:
+        except Exception as e:
             backend = type(inner).__name__
+            logger.debug("backend label fell back to %s: %s", backend, e)
         self.backend = backend
         # hot-path children pre-resolved once (labels() locks a dict)
         self._h_get = _DUR.labels("GET", backend)

@@ -341,8 +341,10 @@ class CircuitBreaker:
                     return
                 try:
                     ok = bool(self.probe())
-                except Exception:
+                except Exception as e:
                     ok = False
+                    logger.debug("%s: half-open probe raised: %s",
+                                 self.backend, e)
                 with self._lock:
                     if self._state == BreakerState.OPEN and ok:
                         self._state = BreakerState.HALF_OPEN
@@ -554,8 +556,10 @@ class ResilientStorage(ObjectStorage):
         if not backend:
             try:
                 backend = inner.string().split("://", 1)[0] or type(inner).__name__
-            except Exception:
+            except Exception as e:
                 backend = type(inner).__name__
+                logger.debug("backend label fell back to %s: %s",
+                             backend, e)
         # `backend` stays scheme-shaped (it keys the metered GET histogram
         # the hedge delay reads); `metric_backend` is the breaker's CLAIMED
         # label — unique among live stores, so two same-scheme endpoints
@@ -616,7 +620,8 @@ class ResilientStorage(ObjectStorage):
             self._s.head(_PROBE_KEY)
         except NotFoundError:
             return True
-        except Exception:
+        except Exception as e:
+            logger.debug("probe HEAD failed (still down): %s", e)
             return False
         return True
 

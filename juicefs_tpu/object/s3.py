@@ -86,14 +86,14 @@ class _ConnPool:
                 return
         try:
             conn.close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # socket already dead: exactly why it was released
 
     def discard(self, conn) -> None:
         try:
             conn.close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # stale socket being discarded: already broken
 
     def close(self) -> None:
         with self._lock:
@@ -101,8 +101,8 @@ class _ConnPool:
         for c in conns:
             try:
                 c.close()
-            except Exception:
-                pass
+            except OSError:
+                pass  # pool teardown of an already-dead socket
 
 
 class SigV4:

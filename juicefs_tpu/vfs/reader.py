@@ -459,8 +459,12 @@ class DataReader:
         queue or closing reader) — the caller rolls back its reservation."""
         try:
             fut = self.ppool.submit(fr._readahead, off, size)
-        except RuntimeError:
-            fut = None  # racing close(): the mount no longer wants warming
+        except Exception:
+            # racing close() (RuntimeError), scheduler backpressure
+            # leaking out of a demoted submit (TimeoutError), or anything
+            # else: a readahead plan is advisory — shed it, never let the
+            # failure reach the read that only wanted to be faster
+            fut = None
         if fut is None:
             _PLAN_SHED.inc()
             return False
@@ -471,8 +475,8 @@ class DataReader:
         """Queue the sequential-EOF epoch hook (fire-and-forget)."""
         try:
             self.ppool.submit(self._warm_next_shard, ctx, ino)
-        except RuntimeError:
-            pass
+        except Exception:
+            pass  # advisory epoch warm: any dispatch failure is a shed
 
     def _warm_next_shard(self, ctx: Context, ino: int) -> None:
         """Epoch hook: a streaming handle just finished a shard-shaped

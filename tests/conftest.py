@@ -33,9 +33,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # any juicefs_tpu module creates a lock; set JUICEFS_LOCK_WATCHDOG=0 to
 # run uninstrumented.
 os.environ.setdefault("JUICEFS_LOCK_WATCHDOG", "1")
-from juicefs_tpu.utils import lockwatch  # noqa: E402
+# Txn rerun harness (ISSUE 12): every successful meta txn closure runs
+# TWICE with the first run's writes discarded, asserting byte-identical
+# reruns across kv and sql engines — non-idempotent closures (the
+# double-apply bugs conflict retry triggers in production) become test
+# failures (txnwatch_guard below).  JUICEFS_TXN_RERUN=0 to disable.
+os.environ.setdefault("JUICEFS_TXN_RERUN", "1")
+from juicefs_tpu.utils import lockwatch, txnwatch  # noqa: E402
 
 lockwatch.install()
+txnwatch.install()
 
 
 import contextlib
@@ -53,6 +60,21 @@ def lockwatch_guard():
     new = lockwatch.violations()[before:]
     assert not new, "lock watchdog violations:\n" + "\n\n".join(
         f"[{v['kind']}] {v['detail']} (thread {v['thread']})\n{v['stack']}"
+        for v in new
+    )
+
+
+@pytest.fixture(autouse=True)
+def txnwatch_guard():
+    """Fail any test during which the txn rerun harness caught a
+    non-idempotent transaction closure (result/write-set divergence
+    between the doubled runs)."""
+    before = len(txnwatch.violations())
+    yield
+    new = txnwatch.violations()[before:]
+    assert not new, "txn rerun violations:\n" + "\n\n".join(
+        f"[{v['engine']}] {v['closure']}: {v['detail']} "
+        f"(thread {v['thread']})"
         for v in new
     )
 

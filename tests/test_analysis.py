@@ -838,7 +838,9 @@ def test_cli_fails_with_readable_output_on_fixture(tmp_path):
     assert doc["findings"][0]["line"] == 5
 
 
-def test_cli_stale_listing(tmp_path):
+def test_cli_stale_listing_fails(tmp_path):
+    """--stale is the CI gate (ISSUE 12): a stale allow() will silence
+    the NEXT real finding on its line, so tier-1 fails on it."""
     root = _write_tree(tmp_path, {"st.py": """
         import time
 
@@ -851,9 +853,77 @@ def test_cli_stale_listing(tmp_path):
         capture_output=True, text=True, timeout=120, cwd=_ROOT,
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
     )
-    assert p.returncode == 0   # stale is a warning, not a failure
+    assert p.returncode == 1, p.stdout + p.stderr
     assert "stale-suppression" in p.stdout
     assert "obsolete" in p.stdout
+    assert "prune" in p.stderr
+    # without --stale the same tree passes (stale stays a warning)
+    p2 = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--ast", "--root", root],
+        capture_output=True, text=True, timeout=120, cwd=_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p2.returncode == 0, p2.stdout + p2.stderr
+
+
+def test_cli_stale_gate_green_on_real_tree():
+    """Tier-1 wiring: the repo itself must carry no stale allow()s."""
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--ast", "--stale"],
+        capture_output=True, text=True, timeout=180, cwd=_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_cli_json_schema_round_trip(tmp_path):
+    """The --json document round-trips into the in-process report: same
+    findings (as Finding objects), same suppression/stale records."""
+    from tools.analyze import Finding, analyze
+
+    root = _write_tree(tmp_path, {"rt.py": """
+        import threading
+        import time
+
+        class RT:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def bad(self):
+                with self._l:
+                    time.sleep(1)
+
+            def vetted(self):
+                with self._l:
+                    time.sleep(0.1)  # analyze: allow(blocking-under-lock) -- drill: round-trip fixture
+
+        def fine():
+            time.sleep(0.1)  # analyze: allow(lock-order) -- stale on purpose
+    """})
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--ast", "--root", root,
+         "--json"],
+        capture_output=True, text=True, timeout=120, cwd=_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 1
+    doc = json.loads(p.stdout)
+    assert set(doc) == {"findings", "suppressed", "stale"}
+    # every finding record reconstructs into an identical Finding
+    report = analyze(root=root, runtime=False)
+    rebuilt = [Finding(**f) for f in doc["findings"]]
+    assert rebuilt == report.findings
+    assert all(set(f) == {"file", "line", "rule", "message"}
+               for f in doc["findings"])
+    sup = doc["suppressed"]
+    assert len(sup) == len(report.suppressed) == 1
+    assert set(sup[0]) == {"finding", "reason", "comment_line"}
+    assert Finding(**sup[0]["finding"]) == report.suppressed[0][0]
+    assert sup[0]["reason"] == report.suppressed[0][1].reason
+    st = doc["stale"]
+    assert len(st) == len(report.stale) == 1
+    assert set(st[0]) == {"file", "line", "rules", "reason"}
+    assert tuple(st[0]["rules"]) == report.stale[0].rules
 
 
 def test_parse_error_is_a_finding(tmp_path):
@@ -1109,3 +1179,983 @@ def test_prefetch_seam_real_tree_clean():
     report = analyze(runtime=False)
     assert not [f for f in report.findings if f.rule == "prefetch-seam"], \
         [f.render() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# txn-purity (ISSUE 12): closures passed to txn seams must be rerun-safe
+
+_TXN_DIRTY = """
+class Meta:
+    def do_thing(self):
+        out = []
+
+        def fn(tx):
+            out.append(tx.get(b"k"))          # captured accumulator
+            self.ops += 1                     # self-state augment
+            _OPS.inc()                        # metric bump
+            self.storage.put("k", b"x")       # object-store call
+            self.pool.submit(print)           # scheduler dispatch
+            return 0
+
+        return self.client.txn(fn)
+"""
+
+
+def test_txn_purity_direct_effects_fire(tmp_path):
+    report = _run(tmp_path, {"meta.py": _TXN_DIRTY})
+    msgs = [f.message for f in report.findings if f.rule == "txn-purity"]
+    assert len(msgs) == 5, msgs
+    assert any("captured name" in m for m in msgs)
+    assert any("augmented" in m or "self state" in m for m in msgs)
+    assert any("metric" in m for m in msgs)
+    assert any("object-store" in m for m in msgs)
+    assert any("scheduler dispatch" in m for m in msgs)
+
+
+def test_txn_purity_lambda_and_simple_txn_forms(tmp_path):
+    report = _run(tmp_path, {"lam.py": """
+        class Meta:
+            def a(self, out):
+                return self.client.simple_txn(lambda tx: out.append(tx.get(b"k")))
+
+            def b(self):
+                return self.client.txn(lambda tx: _C.inc())
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "txn-purity"]
+    assert len(msgs) == 2, msgs
+    assert any("captured name" in m for m in msgs)
+    assert any("metric" in m for m in msgs)
+
+
+def test_txn_purity_more_effect_shapes(tmp_path):
+    """Self-container mutation, inferred-store I/O, prefetch enqueue,
+    bare-name store put in a lambda, and the self.method closure form
+    (mutation survivors: the receiver/length guards in EffectModel and
+    the Attribute branch of _resolve_closure)."""
+    report = _run(tmp_path, {"shapes.py": """
+        class Meta:
+            def __init__(self):
+                self.store = create_storage("mem://")
+
+            def a(self):
+                def fn(tx):
+                    self.items.append(tx.get(b"k"))   # self-container
+                    return 0
+
+                return self.client.txn(fn)
+
+            def b(self):
+                def fn(tx):
+                    self.store.put("k", b"x")         # inferred store
+                    self.prefetcher.fetch(("k", 1))   # prefetch enqueue
+                    return 0
+
+                return self.client.txn(fn)
+
+            def c(self):
+                return self.client.txn(lambda tx: storage.put("k", b"x"))
+
+            def d(self):
+                return self.client.txn(self._apply)
+
+            def _apply(self, tx):
+                self.applied += 1
+                return 0
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "txn-purity"]
+    assert len(msgs) == 5, msgs
+    assert any("items.append" in m for m in msgs)
+    assert any("object-store put() via self.store" in m for m in msgs)
+    assert any("prefetch enqueue" in m for m in msgs)
+    assert any("performs object-store put()" in m for m in msgs)
+    assert any("applied augmented" in m for m in msgs)
+
+
+def test_txn_purity_del_self_nonlocal_and_labels_metric(tmp_path):
+    """del self.X[...], nonlocal rebinding, and the .labels(...).inc()
+    metric idiom all fire; a .fetch() on a NON-prefetcher receiver does
+    not (mutation survivors: the Delete chain fallback, the nonlocal
+    collector, the labels holder, the prefetcher receiver guard)."""
+    report = _run(tmp_path, {"more.py": """
+        class Meta:
+            def a(self):
+                def fn(tx):
+                    del self.cache[tx.get(b"k")]
+                    return 0
+
+                return self.client.txn(fn)
+
+            def b(self):
+                total = 0
+
+                def fn(tx):
+                    nonlocal total
+                    total = tx.incr_by(b"c", 1)
+                    return 0
+
+                self.client.txn(fn)
+                return total
+
+            def c(self):
+                def fn(tx):
+                    _C.labels("x").inc()
+                    return 0
+
+                return self.client.txn(fn)
+
+            def d(self):
+                def fn(tx):
+                    row = self.table.fetch(tx.get(b"k"))  # not a prefetcher
+                    return row
+
+                return self.client.txn(fn)
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "txn-purity"]
+    assert len(msgs) == 3, msgs
+    assert any("del self.cache" in m for m in msgs)
+    assert any("nonlocal `total`" in m for m in msgs)
+    assert any("labels(...).inc()" in m for m in msgs)
+
+
+def test_txn_purity_lambda_resolves_sibling_nested_def(tmp_path):
+    """A lambda closure calling a nested def from its enclosing scope
+    still resolves transitively (mutation survivor: the lambda scope
+    fallback `cqual or qual`)."""
+    report = _run(tmp_path, {"sib.py": """
+        class Meta:
+            def go(self):
+                def helper(tx):
+                    self.count += 1
+                    return 0
+
+                return self.client.txn(lambda tx: helper(tx))
+    """})
+    hits = [f for f in report.findings if f.rule == "txn-purity"]
+    assert len(hits) == 1, report.findings
+    assert "<helper>()" in hits[0].message
+
+
+def test_txn_purity_transitive_helper_laundering_fires(tmp_path):
+    """Extracting the effect into a same-class helper must not launder
+    it (EffectModel.impure_star closure)."""
+    report = _run(tmp_path, {"laund.py": """
+        class Meta:
+            def do_thing(self):
+                def fn(tx):
+                    self._note(tx)
+                    return 0
+
+                return self.client.txn(fn)
+
+            def _note(self, tx):
+                self._hop(tx)
+
+            def _hop(self, tx):
+                self.applied += 1
+    """})
+    hits = [f for f in report.findings if f.rule == "txn-purity"]
+    assert len(hits) == 1, report.findings
+    assert "_note()" in hits[0].message
+    assert "rerun-unsafe through helpers" in hits[0].message
+
+
+def test_txn_purity_reset_first_and_plain_assign_clean(tmp_path):
+    """The two blessed idioms: reset-first accumulators (the
+    _txn_notify shape) and last-write-wins plain assigns (TTL memo
+    caches, interning) — rerun-idempotent, not findings."""
+    report = _run(tmp_path, {"ok.py": """
+        class Meta:
+            def notify(self):
+                msgs = []
+
+                def fn(tx):
+                    del msgs[:]   # reset-first: rerun starts empty
+                    msgs.append(tx.get(b"k"))
+                    return 0
+
+                return self.client.txn(fn)
+
+            def notify_slice_form(self):
+                msgs = []
+
+                def fn(tx):
+                    msgs[:] = []  # slice-assign reset form
+                    msgs.append(tx.get(b"k"))
+                    return 0
+
+                return self.client.txn(fn)
+
+            def memo(self, info):
+                def fn(tx):
+                    self._cache = (tx.get(b"k"), 1)   # last-write-wins
+                    info.sid = 7                      # ditto
+                    local = []
+                    local.append(tx.get(b"x"))        # closure-local: fine
+                    return local
+
+                return self.client.txn(fn)
+    """})
+    assert [f for f in report.findings if f.rule == "txn-purity"] == [], \
+        report.findings
+
+
+def test_txn_purity_suppression_with_reason(tmp_path):
+    report = _run(tmp_path, {"sup.py": """
+        class Meta:
+            def do_thing(self, out):
+                def fn(tx):
+                    out.append(tx.get(b"k"))  # analyze: allow(txn-purity) -- drill: engine serializes, no retry
+                    return 0
+
+                return self.client.txn(fn)
+    """})
+    assert [f for f in report.findings if f.rule == "txn-purity"] == []
+    assert len(report.suppressed) == 1
+    assert report.suppressed[0][0].rule == "txn-purity"
+
+
+def test_txn_purity_real_tree_clean():
+    from tools.analyze.passes import txn_purity
+
+    files = load_files()
+    assert txn_purity.run(files) == []
+
+
+# ---------------------------------------------------------------------------
+# claim-rollback (ISSUE 12): registered claim pairs release on error paths
+
+def test_claim_rollback_unprotected_call_fires(tmp_path):
+    """A can-raise call between the reservation and its release, with
+    no releasing except/finally: the claim leaks on that path."""
+    report = _run(tmp_path, {"chunk/prefetch.py": """
+        class Prefetcher:
+            def fetch(self, key):
+                self._pending.add(key)
+                fut = self._ex.submit(self._run_one, key)
+                if fut is None:
+                    self._pending.discard(key)
+
+            def _run_one(self, key):
+                try:
+                    self._fetch(key)
+                finally:
+                    self._pending.discard(key)
+    """})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert len(hits) == 1, report.findings
+    assert "submit(...)" in hits[0].message and "leaks" in hits[0].message
+
+
+def test_claim_rollback_releasing_handler_clean(tmp_path):
+    report = _run(tmp_path, {"chunk/prefetch.py": """
+        class Prefetcher:
+            def fetch(self, key):
+                self._pending.add(key)
+                try:
+                    fut = self._ex.submit(self._run_one, key)
+                except Exception:
+                    self._pending.discard(key)
+                    fut = None
+                if fut is None:
+                    self._pending.discard(key)
+
+            def _run_one(self, key):
+                try:
+                    self._fetch(key)
+                finally:
+                    self._pending.discard(key)
+    """})
+    assert [f for f in report.findings if f.rule == "claim-rollback"] \
+        == [], report.findings
+
+
+def test_claim_rollback_never_released_fires(tmp_path):
+    report = _run(tmp_path, {"chunk/prefetch.py": """
+        class Prefetcher:
+            def fetch(self, key):
+                self._pending.add(key)
+
+            def _run_one(self, key):
+                try:
+                    self._fetch(key)
+                finally:
+                    self._pending.discard(key)
+    """})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert len(hits) == 1 and "leaks on every path" in hits[0].message
+
+
+def test_claim_rollback_consumer_must_release_in_finally(tmp_path):
+    """The queue-handoff consumer releases outside a finally: flagged —
+    the claim crossed a thread, only finally discipline balances it."""
+    report = _run(tmp_path, {"chunk/prefetch.py": """
+        class Prefetcher:
+            def fetch(self, key):
+                self._pending.add(key)
+                fut = None
+                try:
+                    fut = self._ex.submit(self._run_one, key)
+                except Exception:
+                    self._pending.discard(key)
+                if fut is None:
+                    self._pending.discard(key)
+
+            def _run_one(self, key):
+                self._fetch(key)
+                self._pending.discard(key)   # skipped if _fetch raises
+    """})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert len(hits) == 1, report.findings
+    assert "finally" in hits[0].message and "_run_one" in hits[0].message
+
+
+def test_claim_rollback_stale_registry_entry_fires(tmp_path):
+    """A file the registry names, whose acquire pattern vanished: the
+    registry must rot visibly, not silently."""
+    report = _run(tmp_path, {"chunk/prefetch.py": """
+        class Prefetcher:
+            def fetch(self, key):
+                return None
+    """})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert len(hits) == 1 and "matches no acquire site" in hits[0].message
+
+
+def test_claim_rollback_gate_charge_pairing(tmp_path):
+    """The limiter pair: a risky call between gate() and charge() means
+    admitted-but-unbilled bytes on the exception path."""
+    report = _run(tmp_path, {"qos/limiter.py": """
+        class TokenBucket:
+            def acquire(self, n, timeout=None):
+                waited = self.gate(timeout)
+                self._s.refresh(n)
+                self.charge(n)
+                return waited
+    """})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert len(hits) == 1 and "refresh(...)" in hits[0].message
+
+
+def test_claim_rollback_else_body_needs_finally_release(tmp_path):
+    """A handler-side release does NOT protect risky calls in the
+    try's `else:` (else-body exceptions bypass the handlers); a
+    finally-side release does."""
+    handler_form = """
+        class Prefetcher:
+            def fetch(self, key):
+                self._pending.add(key)
+                try:
+                    fut = self._ex.submit(self._run_one, key)
+                except Exception:
+                    self._pending.discard(key)
+                else:
+                    self._account(fut)
+                self._pending.discard(key)
+
+            def _run_one(self, key):
+                try:
+                    self._fetch(key)
+                finally:
+                    self._pending.discard(key)
+    """
+    report = _run(tmp_path, {"chunk/prefetch.py": handler_form})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert len(hits) == 1 and "_account(...)" in hits[0].message
+    finally_form = handler_form.replace(
+        "except Exception:\n                    self._pending.discard(key)",
+        "finally:\n                    self._pending.discard(key)")
+    report = _run(tmp_path, {"chunk/prefetch.py": finally_form})
+    assert [f for f in report.findings if f.rule == "claim-rollback"] \
+        == [], report.findings
+
+
+def test_claim_rollback_maxassign_reservation_pair(tmp_path):
+    """The _ra_done shape: `self._ra_done = max(self._ra_done, x)` is
+    the acquire, a plain assign is the rollback; a risky call between
+    them fires, a registered no-raise seam (submit_plan) does not
+    (mutation survivor: the maxassign/assign matcher split)."""
+    dirty = """
+        class FileReader:
+            def read(self, off, size):
+                self._ra_done = max(self._ra_done, off + size)
+                self.dr.plan(off, size)
+                self._ra_done = off
+    """
+    report = _run(tmp_path, {"vfs/reader.py": dirty})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert len(hits) == 1 and "plan(...)" in hits[0].message
+    clean = dirty.replace("self.dr.plan", "self.dr.submit_plan")
+    report = _run(tmp_path, {"vfs/reader.py": clean})
+    assert [f for f in report.findings if f.rule == "claim-rollback"] \
+        == [], report.findings
+
+
+def test_claim_rollback_acquire_line_call_not_flagged(tmp_path):
+    """A call nested in the acquire expression itself cannot leak the
+    claim (if it raises, the claim was never taken) — only calls
+    strictly BETWEEN acquire and release count (mutation survivor:
+    the region boundary)."""
+    report = _run(tmp_path, {"chunk/prefetch.py": """
+        class Prefetcher:
+            def fetch(self, key):
+                self._pending.add(self._mk(key))
+                self._pending.discard(key)
+
+            def _run_one(self, key):
+                try:
+                    self._fetch(key)
+                finally:
+                    self._pending.discard(key)
+    """})
+    assert [f for f in report.findings if f.rule == "claim-rollback"] \
+        == [], report.findings
+
+
+def test_claim_rollback_real_tree_clean():
+    from tools.analyze.passes import claims
+
+    assert claims.run(load_files()) == []
+
+
+# ---------------------------------------------------------------------------
+# degrade-not-raise (ISSUE 12): advisory seams never leak exceptions
+
+def test_degrade_unguarded_seam_fires(tmp_path):
+    report = _run(tmp_path, {"cache/group.py": """
+        class CacheGroup:
+            def fetch(self, key, bsize, parent=None):
+                return self._fetch(key, bsize, parent)
+
+            def warm(self, key):
+                try:
+                    return self._do_warm(key)
+                except Exception:
+                    return False
+    """})
+    hits = [f for f in report.findings if f.rule == "degrade-not-raise"]
+    assert len(hits) == 1, report.findings
+    assert "_fetch(...)" in hits[0].message
+    assert "CacheGroup.fetch" in hits[0].message
+
+
+def test_degrade_narrow_except_still_fires(tmp_path):
+    """A narrow handler does not satisfy the never-raise contract —
+    the unexpected exception class is exactly the one that escapes."""
+    report = _run(tmp_path, {"cache/group.py": """
+        class CacheGroup:
+            def fetch(self, key, bsize, parent=None):
+                try:
+                    return self._fetch(key, bsize, parent)
+                except IOError:
+                    return None
+
+            def warm(self, key):
+                try:
+                    return self._do_warm(key)
+                except Exception:
+                    return False
+    """})
+    hits = [f for f in report.findings if f.rule == "degrade-not-raise"]
+    assert len(hits) == 1 and "_fetch(...)" in hits[0].message
+
+
+def test_degrade_reraising_handler_still_fires(tmp_path):
+    """A broad handler that re-raises is not a degrade — the exception
+    still escapes the seam."""
+    report = _run(tmp_path, {"cache/group.py": """
+        class CacheGroup:
+            def fetch(self, key, bsize, parent=None):
+                try:
+                    return self._fetch(key, bsize, parent)
+                except Exception:
+                    raise
+
+            def warm(self, key):
+                try:
+                    return self._do_warm(key)
+                except Exception:
+                    return False
+    """})
+    hits = [f for f in report.findings if f.rule == "degrade-not-raise"]
+    # both the unprotected body call AND the handler's re-raise surface
+    assert any("_fetch(...)" in h.message for h in hits), hits
+    assert all("CacheGroup.fetch" in h.message for h in hits)
+
+
+def test_degrade_wrapped_seam_clean_and_missing_seam_fires(tmp_path):
+    report = _run(tmp_path, {"cache/group.py": """
+        class CacheGroup:
+            def fetch(self, key, bsize, parent=None):
+                try:
+                    return self._fetch(key, bsize, parent)
+                except Exception:
+                    logger.exception("degraded")
+                    return None
+    """})
+    hits = [f for f in report.findings if f.rule == "degrade-not-raise"]
+    # fetch is compliant; the registered `warm` seam is missing entirely
+    # -> only finding is the fixture's missing-seam (registry must not
+    # rot), and only because the fixture ships the real package too
+    assert [h for h in hits if "fetch" in h.message] == [], hits
+
+
+def test_degrade_risky_call_in_branch_header_fires(tmp_path):
+    """A risky call in an `if` TEST (not its body) still escapes the
+    seam (mutation survivor: the shallow header scan)."""
+    report = _run(tmp_path, {"cache/group.py": """
+        class CacheGroup:
+            def fetch(self, key, bsize, parent=None):
+                if self._peer_ok(key):
+                    return None
+                return None
+
+            def warm(self, key):
+                try:
+                    return self._do_warm(key)
+                except Exception:
+                    return False
+    """})
+    hits = [f for f in report.findings if f.rule == "degrade-not-raise"]
+    assert len(hits) == 1 and "_peer_ok(...)" in hits[0].message
+
+
+def test_degrade_tuple_handler_broad_vs_narrow(tmp_path):
+    """(ValueError, Exception) protects; (ValueError, OSError) does
+    not (mutation survivor: the tuple-handler broadness scan)."""
+    report = _run(tmp_path, {"cache/group.py": """
+        class CacheGroup:
+            def fetch(self, key, bsize, parent=None):
+                try:
+                    return self._fetch(key)
+                except (ValueError, Exception):
+                    return None
+
+            def warm(self, key):
+                try:
+                    return self._do_warm(key)
+                except (ValueError, OSError):
+                    return False
+    """})
+    hits = [f for f in report.findings if f.rule == "degrade-not-raise"]
+    assert len(hits) == 1, report.findings
+    assert "_do_warm(...)" in hits[0].message
+
+
+def test_degrade_else_body_not_protected_by_handler(tmp_path):
+    """An exception raised in a try's `else:` bypasses the handlers —
+    risky calls there escape the seam even when the try is broad."""
+    report = _run(tmp_path, {"cache/group.py": """
+        class CacheGroup:
+            def fetch(self, key, bsize, parent=None):
+                try:
+                    data = self._peek(key)
+                except Exception:
+                    return None
+                else:
+                    return self._fetch(key, bsize, parent)
+
+            def warm(self, key):
+                try:
+                    return self._do_warm(key)
+                except Exception:
+                    return False
+    """})
+    hits = [f for f in report.findings if f.rule == "degrade-not-raise"]
+    assert len(hits) == 1, report.findings
+    assert "_fetch(...)" in hits[0].message
+
+
+def test_degrade_real_tree_clean():
+    from tools.analyze.passes import degrade
+
+    assert degrade.run(load_files()) == []
+
+
+# ---------------------------------------------------------------------------
+# silent-swallow (ISSUE 12): data-plane broad excepts must be observable
+
+def test_swallow_broad_pass_fires_and_variants_clean(tmp_path):
+    report = _run(tmp_path, {"object/drv.py": """
+        class Driver:
+            def a(self):
+                try:
+                    self.op()
+                except Exception:
+                    pass            # finding: pure swallow
+
+            def b(self):
+                try:
+                    self.op()
+                except OSError:
+                    pass            # classified: clean
+
+            def c(self):
+                try:
+                    self.op()
+                except Exception as e:
+                    logger.warning("degraded: %s", e)   # logged: clean
+
+            def d(self):
+                try:
+                    self.op()
+                except Exception:
+                    _ERRS.inc()     # counted: clean
+
+            def e(self):
+                try:
+                    self.op()
+                except Exception as e:
+                    self.fut.set_exception(e)   # forwarded: clean
+    """})
+    hits = [f for f in report.findings if f.rule == "silent-swallow"]
+    assert len(hits) == 1, report.findings
+    assert hits[0].line == 6  # `def a`'s except handler
+
+
+def test_swallow_scope_is_data_plane_only(tmp_path):
+    """meta/ and vfs/ are out of scope: their broad handlers are the
+    txn/degrade passes' business."""
+    report = _run(tmp_path, {"meta/eng.py": """
+        def f(op):
+            try:
+                op()
+            except Exception:
+                pass
+    """})
+    assert [f for f in report.findings if f.rule == "silent-swallow"] == []
+
+
+def test_swallow_suppression_with_reason(tmp_path):
+    report = _run(tmp_path, {"chunk/x.py": """
+        def f(op):
+            try:
+                op()
+            except Exception:  # analyze: allow(silent-swallow) -- drill: vetted benign race
+                pass
+    """})
+    assert [f for f in report.findings if f.rule == "silent-swallow"] == []
+    assert len(report.suppressed) == 1
+
+
+def test_swallow_real_tree_clean():
+    from tools.analyze.passes import swallow
+
+    assert swallow.run(load_files()) == []
+
+
+# ---------------------------------------------------------------------------
+# txnwatch (ISSUE 12): the runtime rerun harness
+
+from juicefs_tpu.utils import txnwatch  # noqa: E402
+
+
+def _memkv():
+    from juicefs_tpu.meta.tkv_client import MemKV
+
+    return MemKV()
+
+
+def _sqlitekv(tmp_path):
+    from juicefs_tpu.meta.tkv_client import SqliteKV
+
+    return SqliteKV(str(tmp_path / "kv.db"))
+
+
+def test_txnwatch_enabled_for_suite_and_doubles():
+    if not txnwatch.active():
+        pytest.skip("txn rerun harness disabled in this run")
+    with txnwatch.scoped_state() as st:
+        kv = _memkv()
+        assert kv.txn(lambda tx: tx.incr_by(b"c", 2)) == 2
+        assert st.snapshot() == []
+        assert st.doubled == 1  # the closure really ran twice
+
+
+@pytest.mark.parametrize("engine", ["memkv", "sqlite3"])
+def test_txnwatch_catches_nonidempotent_closure_kv(tmp_path, engine):
+    """The planted double-apply bug: an append-accumulating closure
+    writes a different value on its rerun — caught on BOTH kv engines."""
+    if not txnwatch.active():
+        pytest.skip("txn rerun harness disabled in this run")
+    kv = _memkv() if engine == "memkv" else _sqlitekv(tmp_path)
+    try:
+        with txnwatch.scoped_state() as st:
+            acc = []
+
+            def bad(tx):
+                acc.append(1)   # survives the rerun: non-idempotent
+                tx.set(b"k", len(acc).to_bytes(2, "big"))
+                return len(acc)
+
+            kv.txn(bad)
+            v = [x for x in st.snapshot() if x["kind"] == "txn-rerun"]
+        assert len(v) == 1, v
+        assert v[0]["engine"] == engine
+        assert "diverged" in v[0]["detail"]
+        assert "bad" in v[0]["closure"]
+    finally:
+        kv.close()
+
+
+def test_txnwatch_catches_nonidempotent_closure_sql(tmp_path):
+    """Same drill on the relational engine: the recorded mutating-SQL
+    stream diverges between the runs."""
+    if not txnwatch.active():
+        pytest.skip("txn rerun harness disabled in this run")
+    from juicefs_tpu.meta.sql import SQLMeta
+
+    m = SQLMeta(str(tmp_path / "meta.db"))
+    try:
+        with txnwatch.scoped_state() as st:
+            acc = []
+
+            def bad(cur):
+                acc.append(1)
+                cur.execute(
+                    "INSERT OR REPLACE INTO setting(name, value) "
+                    "VALUES('drill', ?)", (str(len(acc)),))
+                return 0
+
+            m._txn(bad)
+            v = [x for x in st.snapshot() if x["kind"] == "txn-rerun"]
+        assert len(v) == 1, v
+        assert v[0]["engine"] == "sql"
+        assert "write set diverged" in v[0]["detail"]
+    finally:
+        m.shutdown()
+
+
+def test_txnwatch_clock_replay_makes_timestamps_rerun_safe():
+    """A closure stamping time.time() is legitimate (mtime updates do
+    it everywhere): the rerun REPLAYS the first run's readings, so it
+    is not a false positive."""
+    if not txnwatch.active():
+        pytest.skip("txn rerun harness disabled in this run")
+    with txnwatch.scoped_state() as st:
+        kv = _memkv()
+        import struct
+
+        def stamper(tx):
+            tx.set(b"t", struct.pack(">d", time.time()))
+            return 0
+
+        kv.txn(stamper)
+        assert st.snapshot() == [], st.snapshot()
+
+
+def test_txnwatch_clock_multi_read_order_and_exhaustion():
+    """Reruns replay multiple clock readings IN ORDER; a rerun reading
+    MORE times than recorded falls back to the last reading instead of
+    crashing; and the clock patch is fully RESTORED once no doubled run
+    is in flight (mutation survivors: the replay cursor and the
+    refcounted unpatch)."""
+    if not txnwatch.active():
+        pytest.skip("txn rerun harness disabled in this run")
+    import struct
+    import time as _time_mod
+
+    with txnwatch.scoped_state() as st:
+        kv = _memkv()
+
+        def stamper3(tx):
+            tx.set(b"t", struct.pack(">ddd", time.time(), time.time(),
+                                     time.time()))
+            return 0
+
+        kv.txn(stamper3)
+        assert st.snapshot() == [], st.snapshot()
+
+        calls = {"n": 0}
+
+        def hungry(tx):
+            calls["n"] += 1
+            t = time.time()
+            if calls["n"] > 1:
+                t = time.time()  # the rerun reads one extra time
+            tx.set(b"k", struct.pack(">d", t))
+            return 0
+
+        kv.txn(hungry)  # exhausted replay holds the last reading: the
+        # write stays byte-identical and nothing crashes
+        assert st.snapshot() == [], st.snapshot()
+    assert _time_mod.time is txnwatch._REAL_TIME
+    assert _time_mod.monotonic is txnwatch._REAL_MONO
+
+
+def test_txnwatch_active_requires_install_and_env(monkeypatch):
+    monkeypatch.setenv("JUICEFS_TXN_RERUN", "0")
+    saved = txnwatch._installed
+    txnwatch._installed = True
+    try:
+        assert not txnwatch.active()  # env gate off: installed alone is not active
+    finally:
+        txnwatch._installed = saved
+
+
+def test_txnwatch_rerun_raise_is_a_violation():
+    """A closure that CONSUMES captured state (pop) dies on its rerun:
+    recorded as a violation, and the exception still propagates."""
+    if not txnwatch.active():
+        pytest.skip("txn rerun harness disabled in this run")
+    with txnwatch.scoped_state() as st:
+        kv = _memkv()
+        stack = [b"only"]
+
+        def consumer(tx):
+            tx.set(b"k", stack.pop())
+            return 0
+
+        with pytest.raises(IndexError):
+            kv.txn(consumer)
+        v = st.snapshot()
+        assert len(v) == 1 and "rerun raised IndexError" in v[0]["detail"]
+
+
+def test_txnwatch_read_divergence_not_flagged():
+    """The writes-as-a-function-of-reads contract: when the two runs
+    READ different state (a concurrent writer on a shared backend),
+    divergent writes are the conflict machinery's business, not a
+    purity violation."""
+    if not txnwatch.enabled():
+        pytest.skip("txn rerun harness disabled in this run")
+    calls = {"n": 0}
+
+    def run_once():
+        calls["n"] += 1
+        base = calls["n"]          # models a moving shared read
+        return base + 1, {b"k": base}, False, {b"k": base}
+
+    with txnwatch.scoped_state() as st:
+        txnwatch.double_run("redis", run_once, run_once)
+        assert st.snapshot() == []
+
+    # identical reads + divergent writes IS flagged
+    calls["n"] = 0
+
+    def run_fixed_reads():
+        calls["n"] += 1
+        return calls["n"], {b"k": calls["n"]}, False, {b"k": b"same"}
+
+    with txnwatch.scoped_state() as st:
+        txnwatch.double_run("redis", run_fixed_reads, run_fixed_reads)
+        v = st.snapshot()
+        assert len(v) == 1 and "diverged" in v[0]["detail"]
+
+
+def test_txnwatch_discarded_closure_not_doubled():
+    """An errno-abort (discard) attempt is not rerun — only SUCCESSFUL
+    closures double (the discard path never commits anything to
+    double-apply)."""
+    if not txnwatch.active():
+        pytest.skip("txn rerun harness disabled in this run")
+    with txnwatch.scoped_state() as st:
+        kv = _memkv()
+        runs = []
+
+        def aborter(tx):
+            runs.append(1)
+            tx.set(b"k", b"v")
+            tx.discard()
+            return 17
+
+        assert kv.txn(aborter) == 17
+        assert len(runs) == 1
+        assert st.doubled == 0
+        assert kv.txn(lambda tx: tx.get(b"k")) is None  # never committed
+
+
+def test_txnwatch_canon_units():
+    """canon(): address-free structural form, bounded depth, bounded
+    repr fallback (mutation survivors: the guard constants)."""
+    class Obj:
+        pass
+
+    o = Obj()
+    o.x = 3
+    assert txnwatch.canon(o) == ("Obj", ("x", 3))
+    assert txnwatch.canon(memoryview(b"ab")) == b"ab"
+
+    # nesting past the depth guard truncates (bounded string) instead of
+    # recursing to the bottom — on EVERY container branch.  The payload
+    # is long so full recursion is distinguishable from the cutoff.
+    def bottom_of(c):
+        # the payload always sits in the LAST slot of tuple forms (the
+        # ("Class", ("attr", value)) and ("key", value) shapes)
+        while isinstance(c, (tuple, frozenset)):
+            c = (c[-1] if isinstance(c, tuple) else next(iter(c))) \
+                if c else ""
+        return c
+
+    payload = "z" * 400
+    deep_list = cur = []
+    deep_set = payload
+    deep_dict = payload
+    deep_obj = payload
+    for _ in range(12):
+        nxt = []
+        cur.append(nxt)
+        deep_set = frozenset([deep_set])
+        deep_dict = {"k": deep_dict}
+        class _N:  # noqa: E306
+            pass
+        n = _N()
+        n.v = deep_obj
+        deep_obj = n
+        cur = nxt
+    cur.append(payload)
+    for deep in (deep_list, deep_set, deep_dict, deep_obj):
+        c = bottom_of(txnwatch.canon(deep))
+        assert isinstance(c, str) and len(c) <= 200, (type(deep), c[:50])
+
+    class Loud:
+        __slots__ = ()
+
+        def __repr__(self):
+            return "z" * 500
+
+    assert len(txnwatch.canon(Loud())) == 200
+
+
+def test_txnwatch_recording_cursor_mutating_filter():
+    RC = txnwatch.RecordingCursor
+    assert RC._mutating("  UPDATE t SET x=1")
+    assert RC._mutating("insert into t values (1)")
+    assert not RC._mutating("SELECT 1")
+    assert not RC._mutating("")   # blank statement: not mutating, no crash
+
+
+def test_txnwatch_double_run_inactive_is_single_and_sliced(monkeypatch):
+    """Inactive harness: exactly one run, and a 4-tuple (reads-bearing)
+    runner still yields the engine-facing 3-tuple."""
+    monkeypatch.setenv("JUICEFS_TXN_RERUN", "0")
+    saved = txnwatch._installed
+    txnwatch._installed = False
+    try:
+        calls = []
+
+        def run_once():
+            calls.append(1)
+            return "r", {b"k": b"v"}, False, {b"k": b"v"}
+
+        out = txnwatch.double_run("redis", run_once, run_once)
+        assert out == ("r", {b"k": b"v"}, False)
+        assert len(calls) == 1
+    finally:
+        txnwatch._installed = saved
+
+
+def test_txnwatch_install_noop_when_disabled(monkeypatch):
+    import time as _time
+
+    monkeypatch.setenv("JUICEFS_TXN_RERUN", "0")
+    assert not txnwatch.enabled()
+    saved_flag = txnwatch._installed
+    saved_time = _time.time
+    try:
+        txnwatch._installed = False
+        assert txnwatch.install() is False
+        assert _time.time is saved_time, \
+            "install() patched the clock while disabled"
+    finally:
+        txnwatch._installed = saved_flag
+        _time.time = saved_time

@@ -14,6 +14,13 @@ Passes (docs/ARCHITECTURE.md "Checked concurrency contracts"):
   thread-daemon/-shutdown  explicit daemon=, teardown reachability
   qos-seam / resilience-seam / ingest-seam  (migrated from lint_metrics)
   metric-registry      runtime registry hygiene + pinned series
+
+Effect & error-path passes (ISSUE 12, docs/ARCHITECTURE.md "Checked
+effect contracts"), built on the shared EffectModel:
+  txn-purity           txn/simple_txn closures are rerun-safe
+  claim-rollback       registered claim pairs release on every error path
+  degrade-not-raise    advisory seams never let exceptions escape
+  silent-swallow       data-plane broad excepts count/log/classify
 """
 
 from __future__ import annotations
@@ -31,7 +38,9 @@ from .core import (  # noqa: F401  (public API)
     run_passes,
 )
 from .passes import AST_PASSES, RUNTIME_PASSES  # noqa: F401
-from .passes import blocking, lane_graph, lock_order, metrics, seams, threads
+from .passes import (blocking, claims, degrade, lane_graph, lock_order,
+                     metrics, seams, swallow, threads, txn_purity)
+from .passes.effects import EffectModel  # noqa: F401
 from .passes.locks import LockModel  # noqa: F401
 
 
@@ -55,6 +64,11 @@ def analyze(root: str = DEFAULT_ROOT, runtime: bool = True,
     findings.extend(lane_graph.run(files, model))
     findings.extend(threads.run(files))
     findings.extend(seams.run(files))
+    effects = EffectModel(files, model)
+    findings.extend(txn_purity.run(files, model, effects))
+    findings.extend(claims.run(files))
+    findings.extend(degrade.run(files))
+    findings.extend(swallow.run(files))
     if runtime:
         findings.extend(metrics.run(files))
     findings.sort(key=lambda f: (f.file, f.line, f.rule))

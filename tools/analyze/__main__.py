@@ -4,7 +4,10 @@ Exit 1 on any unsuppressed finding, printed one per line as
 ``file:line rule: message`` (the CI contract, tests/test_analysis.py).
 
   --json    machine-readable report (findings, suppressed, stale)
-  --stale   list suppressions whose rule no longer fires on their line
+  --stale   ALSO fail (exit 1) on stale suppressions — an allow() whose
+            rule no longer fires is a dead justification that will
+            silence the NEXT real finding on that line; tier-1 runs
+            this mode so stale allows rot out of the tree (ISSUE 12)
   --ast     skip the runtime metric-registry pass (pure-AST mode)
   --root    analyze a different tree (fixtures, tests)
 """
@@ -27,7 +30,8 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=DEFAULT_ROOT)
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--stale", action="store_true",
-                    help="list stale suppressions (rule no longer fires)")
+                    help="list stale suppressions (rule no longer fires) "
+                         "and exit 1 when any exist")
     ap.add_argument("--ast", action="store_true",
                     help="skip the runtime metric-registry pass")
     args = ap.parse_args(argv)
@@ -48,7 +52,8 @@ def main(argv=None) -> int:
                 for s in report.stale
             ],
         }, indent=2))
-        return 1 if report.failed else 0
+        return 1 if (report.failed
+                     or (args.stale and report.stale)) else 0
 
     for f in report.findings:
         print(f.render(), file=sys.stderr)
@@ -60,6 +65,10 @@ def main(argv=None) -> int:
     if report.failed:
         print(f"tools.analyze: {len(report.findings)} unsuppressed "
               f"finding(s)", file=sys.stderr)
+        return 1
+    if args.stale and report.stale:
+        print(f"tools.analyze: {len(report.stale)} stale suppression(s) "
+              "— prune the dead allow() comments", file=sys.stderr)
         return 1
     print(f"tools.analyze: OK ({len(report.suppressed)} suppressed, "
           f"{len(report.stale)} stale)")

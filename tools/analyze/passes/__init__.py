@@ -4,7 +4,8 @@ package and inspect live state (the metric registry)."""
 
 from __future__ import annotations
 
-from . import blocking, lane_graph, lock_order, metrics, seams, threads
+from . import (blocking, claims, degrade, lane_graph, lock_order, metrics,
+               seams, swallow, threads, txn_purity)
 
 AST_PASSES = [
     lock_order.PASS,
@@ -12,6 +13,10 @@ AST_PASSES = [
     lane_graph.PASS,
     threads.PASS,
     seams.PASS,
+    txn_purity.PASS,
+    claims.PASS,
+    degrade.PASS,
+    swallow.PASS,
 ]
 RUNTIME_PASSES = [metrics.PASS]
 ALL_PASSES = AST_PASSES + RUNTIME_PASSES

@@ -1,0 +1,301 @@
+"""Claim/rollback pairing lint (rule ``claim-rollback``).
+
+PRs 8-10 grew a family of *claims*: a counter, set entry or reservation
+is taken optimistically, work is attempted, and the claim must be
+released on EVERY outcome — including the exception paths.  A leaked
+claim is silent and cumulative: ``flush()`` waits forever on a
+``_final_pending`` that never drains, a prefetch key stays "pending" and
+is never re-fetched, a readahead reservation pins window bytes nothing
+planned.  No functional test catches the leak until the exact failure
+interleaving happens under load.
+
+``CLAIM_REGISTRY`` names each acquire/release pair (like the lane
+pass's DECLARED_LANE_EDGES, reviewed and updated with the code):
+
+* between an acquire and the first matching release/handoff, every
+  raise-capable call must be PROTECTED — inside a ``try`` whose handler
+  or ``finally`` performs a release (a can-raise call between acquire
+  and bare release is a finding);
+* a function that acquires but can never reach a release or handoff is
+  a finding outright;
+* declared CONSUMERS (the other end of a queue handoff) must release in
+  a ``finally`` — the claim crossed a thread, so only ``finally``
+  discipline keeps it balanced;
+* a registry entry that no longer matches any acquire site in its file
+  is itself a finding (the registry must track refactors, not rot).
+
+Calls to registered degrade-not-raise seams count as safe here — their
+no-raise contract is enforced at their own definition by the degrade
+pass (that composition is what lets ``FileReader.read`` hold the
+``_ra_done`` reservation across ``submit_plan`` without a try/finally).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core import Finding, Pass, SourceFile, attr_chain
+from .degrade import SEAM_SAFE_NAMES
+from .effects import is_safe_call
+
+
+@dataclass(frozen=True)
+class ClaimPair:
+    file: str                       # pkg-relative path
+    name: str                       # human-readable claim id
+    acquire: tuple
+    releases: tuple = ()
+    handoffs: tuple = ()            # ownership transfer (queue put, ...)
+    consumers: tuple = ()           # (func_name, (required releases...))
+    funcs: tuple = ()               # restrict acquire scan to these defs
+
+
+# matcher kinds:
+#   ("aug+", attr)         self.attr += ...
+#   ("aug-", attr)         self.attr -= ...
+#   ("mcall", recv, m)     self.recv.m(...)
+#   ("scall", m)           self.m(...)
+#   ("maxassign", attr)    self.attr = max(self.attr, ...)
+#   ("assign", attr)       self.attr = <expr>   (release/rollback form)
+#   ("callm", m)           <anything>.m(...)
+CLAIM_REGISTRY = (
+    ClaimPair(
+        file="chunk/ingest.py",
+        name="ingest finalizer claim (_final_pending)",
+        acquire=("aug+", "_final_pending"),
+        releases=(("aug-", "_final_pending"),),
+        handoffs=(("mcall", "_finalq", "put"),),
+        consumers=(("_finalize_loop",
+                    (("aug-", "_final_pending"),
+                     ("scall", "_settle_inflight"))),),
+    ),
+    ClaimPair(
+        file="chunk/ingest.py",
+        name="in-flight-register overlay (_inflight_reg)",
+        acquire=("mcall", "_inflight_reg", "setdefault"),
+        releases=(("scall", "_settle_inflight"),
+                  ("mcall", "_inflight_reg", "pop")),
+        handoffs=(("mcall", "_finalq", "put"),),
+    ),
+    ClaimPair(
+        file="chunk/prefetch.py",
+        name="prefetch pending reservation (_pending)",
+        acquire=("mcall", "_pending", "add"),
+        releases=(("mcall", "_pending", "discard"),),
+        consumers=(("_run_one", (("mcall", "_pending", "discard"),)),),
+    ),
+    ClaimPair(
+        file="vfs/reader.py",
+        name="readahead frontier reservation (_ra_done)",
+        acquire=("maxassign", "_ra_done"),
+        releases=(("assign", "_ra_done"),),
+    ),
+    ClaimPair(
+        file="qos/limiter.py",
+        name="bandwidth admission debt (gate must reach charge)",
+        acquire=("callm", "gate"),
+        releases=(("callm", "charge"),),
+        funcs=("acquire",),
+    ),
+)
+
+
+def _pkg_rel(sf: SourceFile) -> str:
+    return sf.rel.split("/", 1)[1] if "/" in sf.rel else sf.rel
+
+
+def _matches(node, matcher) -> bool:
+    kind = matcher[0]
+    if kind in ("aug+", "aug-"):
+        if not isinstance(node, ast.AugAssign):
+            return False
+        ok_op = isinstance(node.op, ast.Add) if kind == "aug+" \
+            else isinstance(node.op, ast.Sub)
+        chain = attr_chain(node.target)
+        return ok_op and chain is not None and chain[0] == "self" \
+            and chain[-1] == matcher[1]
+    if kind == "mcall":
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            return False
+        chain = attr_chain(node.func)
+        return node.func.attr == matcher[2] and chain is not None \
+            and len(chain) >= 3 and chain[0] == "self" \
+            and chain[-2] == matcher[1]
+    if kind == "scall":
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            return False
+        chain = attr_chain(node.func)
+        return chain == ["self", matcher[1]]
+    if kind in ("maxassign", "assign"):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            return False
+        chain = attr_chain(node.targets[0])
+        if chain is None or chain[0] != "self" or chain[-1] != matcher[1]:
+            return False
+        is_max = (isinstance(node.value, ast.Call)
+                  and getattr(node.value.func, "id", None) == "max")
+        return is_max if kind == "maxassign" else not is_max
+    if kind == "callm":
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == matcher[1])
+    return False
+
+
+def _any_match(node, matchers) -> bool:
+    return any(_matches(node, m) for m in matchers)
+
+
+@dataclass
+class _FnScan:
+    acquires: list = field(default_factory=list)    # lines
+    terminators: list = field(default_factory=list)  # lines (release|handoff)
+    risky: list = field(default_factory=list)  # (line, desc, protected)
+
+
+def _scan_fn(fn, pair: ClaimPair) -> _FnScan:
+    scan = _FnScan()
+    term = tuple(pair.releases) + tuple(pair.handoffs)
+
+    def walk(node, protected: bool, in_handler: bool = False):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return  # deferred code: its own contract
+        if _matches(node, pair.acquire):
+            scan.acquires.append(node.lineno)
+        elif _any_match(node, term):
+            # a release inside an except handler runs only on the
+            # EXCEPTION path: it is protection, not the normal-flow
+            # terminator the acquire's region scan looks for
+            if not in_handler:
+                scan.terminators.append(node.lineno)
+        elif isinstance(node, ast.Call) and not is_safe_call(node):
+            name = (getattr(node.func, "attr", None)
+                    or getattr(node.func, "id", "?"))
+            if name not in SEAM_SAFE_NAMES:
+                scan.risky.append((node.lineno, f"{name}(...)", protected))
+        if isinstance(node, ast.Try):
+            releasing = _try_releases(node, pair)
+            fin_rel = _region_releases(node.finalbody, pair)
+            for st in node.body:
+                walk(st, protected or releasing, in_handler)
+            for h in node.handlers:
+                for st in h.body:
+                    walk(st, protected, True)
+            # else-body exceptions BYPASS the handlers, so only a
+            # finally-side release protects them
+            for st in node.orelse:
+                walk(st, protected or fin_rel, in_handler)
+            for st in node.finalbody:
+                walk(st, protected, in_handler)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, protected, in_handler)
+
+    for st in fn.body:
+        walk(st, False)
+    return scan
+
+
+def _try_releases(node: ast.Try, pair: ClaimPair) -> bool:
+    """True when this try's handlers or finally perform a release —
+    the protection that makes can-raise calls in its body claim-safe."""
+    return any(_region_releases(r, pair)
+               for r in [node.finalbody] + [h.body for h in node.handlers])
+
+
+def _region_releases(region, pair: ClaimPair) -> bool:
+    rel = tuple(pair.releases)
+    for st in region:
+        for sub in ast.walk(st):
+            if _any_match(sub, rel):
+                return True
+    return False
+
+
+def _fn_defs(sf: SourceFile):
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            yield node
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    by_pkg = {_pkg_rel(sf): sf for sf in files}
+    for pair in CLAIM_REGISTRY:
+        sf = by_pkg.get(pair.file)
+        if sf is None or sf.tree is None:
+            continue  # fixture trees carry only the files they seed
+        fns = [fn for fn in _fn_defs(sf)
+               if not pair.funcs or fn.name in pair.funcs]
+        matched = False
+        for fn in fns:
+            scan = _scan_fn(fn, pair)
+            if not scan.acquires:
+                continue
+            matched = True
+            for la in scan.acquires:
+                after = [t for t in scan.terminators if t >= la]
+                if not after:
+                    findings.append(Finding(
+                        sf.rel, la, "claim-rollback",
+                        f"{pair.name}: acquired in {fn.name}() but no "
+                        "release/handoff is reachable afterwards — the "
+                        "claim leaks on every path"))
+                    continue
+                lr = min(after)
+                for line, desc, protected in scan.risky:
+                    if la < line < lr and not protected:
+                        findings.append(Finding(
+                            sf.rel, line, "claim-rollback",
+                            f"{pair.name}: {desc} can raise between the "
+                            f"acquire (line {la}) and the release "
+                            f"(line {lr}) with no releasing "
+                            "except/finally — the claim leaks on that "
+                            "path"))
+        if not matched:
+            findings.append(Finding(
+                sf.rel, 0, "claim-rollback",
+                f"registry entry `{pair.name}` matches no acquire site "
+                f"in {pair.file} — update CLAIM_REGISTRY with the "
+                "refactor"))
+            continue
+        for cname, required in pair.consumers:
+            cfn = next((f for f in _fn_defs(sf) if f.name == cname), None)
+            if cfn is None:
+                findings.append(Finding(
+                    sf.rel, 0, "claim-rollback",
+                    f"{pair.name}: declared consumer {cname}() not found "
+                    "— update CLAIM_REGISTRY"))
+                continue
+            for req in required:
+                if not _released_in_finally(cfn, req):
+                    findings.append(Finding(
+                        sf.rel, cfn.lineno, "claim-rollback",
+                        f"{pair.name}: consumer {cname}() must release "
+                        f"({req}) inside a finally — the claim crossed a "
+                        "thread and only finally discipline balances it"))
+    return findings
+
+
+def _released_in_finally(fn, matcher) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try):
+            for st in node.finalbody:
+                for sub in ast.walk(st):
+                    if _matches(sub, matcher):
+                        return True
+    return False
+
+
+PASS = Pass(
+    name="claim-rollback",
+    rules=("claim-rollback",),
+    run=run,
+    doc="registered claim/reservation pairs release on every exception "
+        "path; queue-handoff consumers release in finally",
+)

@@ -1,0 +1,208 @@
+"""Advisory-seam error-path lint (rule ``degrade-not-raise``).
+
+Some functions sit on seams whose written contract is *degrade, never
+fail*: speculative prefetch bodies, cache-group peer fetch/warm, the
+ingest submit path, peer warm-hint handlers.  An exception escaping one
+of these either fails a foreground operation that the seam was supposed
+to merely accelerate (reader readahead, dedup elision) or kills a daemon
+worker/handler thread outright — and no functional test catches it,
+because the happy path is byte-identical.  PRs 8-10 each grew one of
+these seams; their exception paths are exactly where the next
+deadlock-class bug hides.
+
+``ADVISORY_SEAMS`` is the reviewed registry (like the lane pass's
+DECLARED_LANE_EDGES): every listed function must route all risky work
+through a broad ``except Exception`` handler that does not re-raise.
+The checker walks the function body; any statement containing a
+non-safe call (``effects.is_safe_call``) or a ``raise`` that is not
+covered by such a handler is a finding.  Calls to OTHER registered
+seams count as safe (their no-raise contract is enforced at their own
+definition), as do resolved same-class/module helpers that are
+themselves fully wrapped.  A registry entry whose function no longer
+exists is itself a finding — the registry must track refactors, not rot.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Pass, SourceFile
+from .effects import is_safe_call
+
+# (pkg-relative file, class or None, function): the degrade-never-raise
+# contract holders.  Reviewed; additions ride the PR that adds the seam.
+ADVISORY_SEAMS = (
+    ("cache/group.py", "CacheGroup", "fetch"),
+    ("cache/group.py", "CacheGroup", "warm"),
+    ("vfs/reader.py", "DataReader", "submit_plan"),
+    ("vfs/reader.py", "DataReader", "submit_epoch_warm"),
+    ("vfs/reader.py", "DataReader", "_warm_next_shard"),
+    ("chunk/ingest.py", "IngestPipeline", "submit"),
+    ("chunk/ingest.py", "IngestPipeline", "_passthrough"),
+    ("chunk/prefetch.py", "Prefetcher", "fetch"),
+    ("chunk/prefetch.py", "Prefetcher", "_run_one"),
+    ("cache/server.py", "PeerBlockServer", "_warm"),
+)
+
+# seam functions callable-by-name from inside OTHER seams without being
+# re-flagged (their no-raise contract is enforced at their definition).
+# Generic verbs are excluded: `pool.submit` / `prefetcher.fetch` are NOT
+# the registered seams of the same name and can absolutely raise.
+SEAM_SAFE_NAMES = {fn for _f, _c, fn in ADVISORY_SEAMS} - {
+    "submit", "fetch", "warm", "get", "put"}
+
+
+def _pkg_rel(sf: SourceFile) -> str:
+    return sf.rel.split("/", 1)[1] if "/" in sf.rel else sf.rel
+
+
+def _find_fn(sf: SourceFile, cls: str, name: str):
+    """Registry seams are always methods (the seam IS some class's
+    contract surface), so resolution is class-scoped only."""
+    if sf.tree is None:
+        return None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and item.name == name:
+                    return item
+    return None
+
+
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+    names = [getattr(e, "id", getattr(e, "attr", None))
+             for e in (t.elts if isinstance(t, ast.Tuple) else [t])]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _protecting_try(node: ast.Try) -> bool:
+    """A try that upholds the contract: some broad handler, and NO
+    handler re-raises (a classified re-raise belongs above the seam)."""
+    if not any(_broad_handler(h) for h in node.handlers):
+        return False
+    for h in node.handlers:
+        for sub in ast.walk(h):
+            if isinstance(sub, ast.Raise):
+                return False
+    return True
+
+
+def _risky_calls(stmt) -> list:
+    """(line, desc) for every raise-capable operation in `stmt`,
+    ignoring nested function/lambda bodies (deferred code runs under its
+    own contract)."""
+    out = []
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Raise):
+            out.append((node.lineno, "raise"))
+        elif isinstance(node, ast.Call) and not is_safe_call(node):
+            fn = node.func
+            name = getattr(fn, "attr", None) or getattr(fn, "id", "?")
+            if name not in SEAM_SAFE_NAMES:
+                out.append((node.lineno, f"{name}(...)"))
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def check_seam(sf: SourceFile, fn: ast.FunctionDef,
+               label: str) -> list[Finding]:
+    """Every risky statement must sit under a protecting try."""
+    findings: list[Finding] = []
+
+    def walk(stmts, covered: bool):
+        for st in stmts:
+            if isinstance(st, ast.Try):
+                protects = covered or _protecting_try(st)
+                walk(st.body, protects)
+                for h in st.handlers:
+                    walk(h.body, covered)
+                # an `else:` body runs AFTER the try body completed —
+                # its exceptions are NOT caught by the handlers above
+                walk(st.orelse, covered)
+                walk(st.finalbody, covered)
+                continue
+            if isinstance(st, (ast.If, ast.For, ast.While, ast.With)):
+                for line, desc in _risky_calls_shallow(st):
+                    if not covered:
+                        findings.append(_finding(sf, line, label, desc))
+                for body in _inner_bodies(st):
+                    walk(body, covered)
+                continue
+            if not covered:
+                for line, desc in _risky_calls(st):
+                    findings.append(_finding(sf, line, label, desc))
+
+    walk(fn.body, False)
+    return findings
+
+
+def _inner_bodies(st):
+    if isinstance(st, (ast.If, ast.For, ast.While)):
+        yield st.body
+        yield st.orelse
+    elif isinstance(st, ast.With):
+        yield st.body
+
+
+def _risky_calls_shallow(st) -> list:
+    """Risky ops in the statement's own header expressions (an `if`
+    test, a `for` iterator, a `with` context) — its nested bodies are
+    walked separately so inner `try` blocks keep their effect."""
+    headers = []
+    if isinstance(st, ast.If) or isinstance(st, ast.While):
+        headers = [st.test]
+    elif isinstance(st, ast.For):
+        headers = [st.iter]
+    elif isinstance(st, ast.With):
+        headers = [i.context_expr for i in st.items]
+    out = []
+    for h in headers:
+        out.extend(_risky_calls(ast.Expr(value=h)))
+    return out
+
+
+def _finding(sf: SourceFile, line: int, label: str, desc: str) -> Finding:
+    return Finding(
+        sf.rel, line, "degrade-not-raise",
+        f"{desc} can raise out of advisory seam {label} — the contract "
+        "is degrade-never-fail: route it through a broad "
+        "`except Exception` that logs/counts and falls back")
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    by_pkg = {_pkg_rel(sf): sf for sf in files}
+    saw_pkg = any(sf.rel.startswith("juicefs_tpu/") for sf in files)
+    for file, cls, name in ADVISORY_SEAMS:
+        sf = by_pkg.get(file)
+        if sf is None:
+            continue  # fixture trees check only the seams they define
+        fn = _find_fn(sf, cls, name)
+        if fn is None:
+            if saw_pkg:
+                findings.append(Finding(
+                    sf.rel, 0, "degrade-not-raise",
+                    f"registered advisory seam {cls}.{name} not "
+                    "found — update ADVISORY_SEAMS with the refactor"))
+            continue
+        label = f"{cls}.{name}"
+        findings.extend(check_seam(sf, fn, label))
+    return findings
+
+
+PASS = Pass(
+    name="degrade-not-raise",
+    rules=("degrade-not-raise",),
+    run=run,
+    doc="registered advisory seams (prefetch bodies, cache-group "
+        "fetch/warm, ingest submit, warm-hint handlers) never let "
+        "exceptions escape",
+)

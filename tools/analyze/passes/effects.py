@@ -1,0 +1,276 @@
+"""Shared effect-summary model (ISSUE 12): the substrate of the
+effect/error-path passes, the way :class:`LockModel` is the substrate of
+the concurrency passes.
+
+For every function in the pre-parsed file list (reusing LockModel's
+function walk, callee resolution and store tables) this builds a summary
+of EXTERNALLY VISIBLE effects — the operations that make re-running a
+piece of code observable from outside it:
+
+* NON-IDEMPOTENT ``self``-state writes: ``self.X += ...`` /
+  ``del self.X`` and mutating container calls ``self.X.append(...)``.
+  Plain ``self.X = <value>`` (including subscript/attribute forms) is
+  deliberately EXEMPT: a last-write-wins publish re-applies to the same
+  end state on a rerun (the meta layer's TTL hint caches and insert-only
+  ACL interning rely on this, and document their abort-safety); the
+  runtime rerun twin (txnwatch) asserts the byte-identical-rerun part;
+* global writes (``global`` declarations that are assigned);
+* metric effects: ``.inc()/.dec()/.observe()`` (the registry idiom —
+  ``_C.inc()``, ``_C.labels(...).inc()``);
+* I/O and scheduling effects: object-store driver ops on store-like
+  receivers (LockModel's tables) and executor/scheduler dispatch
+  (``.submit/.map/fetch_ordered`` and prefetcher ``.fetch``).
+
+Summaries are closed transitively over resolved same-class/module calls
+(``impure_star``): extracting an effect into a helper must not launder
+it — the exact `blocks_star` shape from the blocking pass.
+
+What static resolution cannot see (effects behind dynamic dispatch,
+mutation of aliased state through plain locals), the runtime rerun
+harness (juicefs_tpu/utils/txnwatch.py) covers — the same division of
+labor as LockModel vs lockwatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core import SourceFile, attr_chain
+from .locks import STOREISH_NAMES, LockModel
+
+# metric registry mutators (".set" is deliberately absent: `tx.set` is
+# the KV transaction write verb and a gauge .set is idempotent anyway)
+METRIC_OPS = {"inc", "dec", "observe"}
+LOG_OPS = {"debug", "info", "warning", "error", "exception", "critical",
+           "log"}
+# container/object methods that mutate their receiver non-idempotently
+MUTATING_METHODS = {
+    "append", "appendleft", "extend", "insert", "add", "update", "remove",
+    "discard", "clear", "pop", "popitem", "setdefault", "push",
+}
+# object-store driver verbs (network side effects; re-running PUTs or
+# DELETEs double-applies them)
+STORE_OPS = {"get", "put", "delete", "head", "copy", "list", "list_all",
+             "upload_part"}
+# executor/scheduler dispatch: a rerun would double-submit the work
+SUBMIT_OPS = {"submit", "map", "fetch_ordered", "submit_plan"}
+
+# calls that can be assumed not to raise / not to have external effects
+# (consumed by the claim-rollback and degrade-not-raise passes): pure
+# builtins plus the repo's well-known pure constructors/parsers
+SAFE_NAME_CALLS = {
+    "len", "str", "int", "float", "bytes", "bytearray", "bool", "list",
+    "dict", "set", "tuple", "frozenset", "sorted", "min", "max", "sum",
+    "abs", "divmod", "round", "isinstance", "issubclass", "getattr",
+    "hasattr", "enumerate", "zip", "range", "repr", "id", "type", "print",
+    "memoryview", "format",
+    # repo-local pure helpers / cheap constructors; _settle_future is the
+    # first-writer-wins future resolver (chunk/ingest.py) — it exists to
+    # swallow the lost-race InvalidStateError, so it cannot raise
+    "parse_block_key", "block_key", "Future", "Event", "OrderedDict",
+    "_settle_future",
+}
+# attribute calls that cannot meaningfully raise: metric/log effects,
+# container ops, future plumbing, lock-free bookkeeping
+SAFE_ATTR_CALLS = (
+    METRIC_OPS | LOG_OPS | MUTATING_METHODS
+    | {"labels", "get", "items", "keys", "values", "add_done_callback",
+       "set_result", "done", "cancelled", "startswith", "endswith",
+       "split", "rsplit", "join", "encode", "decode", "strip", "lstrip",
+       "rstrip", "to_bytes", "from_bytes", "qsize", "copy", "fromkeys",
+       "move_to_end", "record", "kick",
+       # no-raise primitive constructors reached as module attrs
+       # (threading.Event() et al.)
+       "Event", "Lock", "RLock", "Condition", "Semaphore"}
+)
+
+
+def is_safe_call(node: ast.Call) -> bool:
+    """True for calls the error-path passes treat as no-raise."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in SAFE_NAME_CALLS
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in SAFE_ATTR_CALLS
+    return False
+
+
+@dataclass
+class Effect:
+    kind: str    # self-write | self-mutate | global-write | metric | io
+    desc: str
+    line: int
+
+
+@dataclass
+class EffectInfo:
+    """Per-function external-effect summary."""
+
+    qual: str
+    file: str
+    effects: list = field(default_factory=list)   # [Effect]
+
+    def first(self) -> Optional[Effect]:
+        return self.effects[0] if self.effects else None
+
+
+class EffectModel:
+    """Effect summaries for every function LockModel resolved, plus the
+    transitive closure ``impure_star`` over resolved callees."""
+
+    def __init__(self, files: list[SourceFile],
+                 lock_model: Optional[LockModel] = None):
+        self.lock = lock_model if lock_model is not None else LockModel(files)
+        self.files = files
+        self.funcs: dict[str, EffectInfo] = {}
+        for qual, fi in self.lock.funcs.items():
+            if fi.node is not None:
+                self.funcs[qual] = self._summarize(qual, fi)
+        self._close()
+
+    # -- per-function walk -------------------------------------------------
+    def _summarize(self, qual: str, fi) -> EffectInfo:
+        info = EffectInfo(qual, fi.file)
+        fn = fi.node
+        is_ctor = qual.endswith(".__init__")
+        globals_declared: set[str] = set()
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+            self._scan_node(node, fi, info, is_ctor, globals_declared)
+        return info
+
+    @staticmethod
+    def _own_nodes(fn):
+        """Walk `fn` skipping nested function/lambda bodies: deferred
+        code's effects belong to its own summary (nested defs) or to the
+        call-site analysis (lambdas), not to the enclosing frame."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_node(self, node, fi, info: EffectInfo, is_ctor: bool,
+                   globals_declared: set) -> None:
+        # non-idempotent self.X writes (constructors are exempt: __init__
+        # publishing attributes IS construction, and no txn closure is an
+        # __init__; plain `self.X = v` is exempt everywhere — last-write-
+        # wins publishes re-apply to the same end state on a rerun)
+        if isinstance(node, ast.AugAssign):
+            chain = attr_chain(node.target) or (
+                attr_chain(node.target.value)
+                if isinstance(node.target, ast.Subscript) else None)
+            if chain and chain[0] == "self" and len(chain) >= 2 \
+                    and not is_ctor:
+                info.effects.append(Effect(
+                    "self-write",
+                    f"self.{'.'.join(chain[1:])} augmented (op=)",
+                    node.lineno))
+            elif isinstance(node.target, ast.Name) \
+                    and node.target.id in globals_declared:
+                info.effects.append(Effect(
+                    "global-write", f"global {node.target.id} op= ...",
+                    node.lineno))
+        elif isinstance(node, ast.Assign):
+            # writes to `global`-declared names stay flagged even in the
+            # plain form: module state crosses every retry AND every txn
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in globals_declared:
+                    info.effects.append(Effect(
+                        "global-write", f"global {t.id} = ...",
+                        node.lineno))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                chain = attr_chain(t) or (
+                    attr_chain(t.value) if isinstance(t, ast.Subscript)
+                    else None)
+                if chain and chain[0] == "self" and not is_ctor:
+                    info.effects.append(Effect(
+                        "self-write", f"del self.{'.'.join(chain[1:])}",
+                        node.lineno))
+        elif isinstance(node, ast.Call):
+            self._scan_call(node, fi, info, is_ctor)
+
+    def _scan_call(self, node: ast.Call, fi, info: EffectInfo,
+                   is_ctor: bool) -> None:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        attr = fn.attr
+        chain = attr_chain(fn)
+        recv = chain[:-1] if chain else None
+        if attr in METRIC_OPS:
+            # _C.inc() / _C.labels(...).inc(): the receiver is either a
+            # name chain or a .labels(...) call — both are metric idioms
+            holder = ""
+            if recv:
+                holder = ".".join(recv)
+            elif isinstance(fn.value, ast.Call) \
+                    and isinstance(fn.value.func, (ast.Attribute, ast.Name)):
+                holder = (getattr(fn.value.func, "attr", None)
+                          or getattr(fn.value.func, "id", "")) + "(...)"
+            if holder and not holder.startswith("self."):
+                info.effects.append(Effect(
+                    "metric", f"{holder}.{attr}()", node.lineno))
+            return
+        if recv is None:
+            return
+        if attr in MUTATING_METHODS and recv[0] == "self" and len(recv) >= 2 \
+                and not is_ctor:
+            info.effects.append(Effect(
+                "self-mutate", f"self.{'.'.join(recv[1:])}.{attr}(...)",
+                node.lineno))
+            return
+        cls = fi.cls
+        storeish = (
+            recv[-1] in STOREISH_NAMES
+            or (cls is not None and recv[0] == "self" and len(recv) == 2
+                and recv[1] in self.lock.class_stores.get(cls, set()))
+        )
+        if attr in STORE_OPS and storeish:
+            info.effects.append(Effect(
+                "io", f"object-store {attr}() via {'.'.join(recv)}",
+                node.lineno))
+        elif attr in SUBMIT_OPS:
+            info.effects.append(Effect(
+                "io", f"{'.'.join(recv)}.{attr}(...) (scheduler dispatch)",
+                node.lineno))
+        elif attr == "fetch" and recv[-1] in ("prefetcher", "_prefetcher"):
+            info.effects.append(Effect(
+                "io", f"{'.'.join(recv)}.fetch(...) (prefetch enqueue)",
+                node.lineno))
+
+    # -- transitive closure ------------------------------------------------
+    def _close(self) -> None:
+        """impure_star: qual -> (kind, desc, file, line) of the first
+        external effect reachable through resolved calls (fixpoint)."""
+        self.impure_star: dict[str, tuple] = {}
+        for qual, info in self.funcs.items():
+            eff = info.first()
+            if eff is not None:
+                self.impure_star[qual] = (eff.kind, eff.desc, info.file,
+                                          eff.line)
+        changed = True
+        while changed:
+            changed = False
+            for qual, fi in self.lock.funcs.items():
+                if qual in self.impure_star:
+                    continue
+                for callee in fi.callees:
+                    hit = self.impure_star.get(callee)
+                    if hit is not None:
+                        kind, desc, f, ln = hit
+                        short = callee.rsplit("::", 1)[-1]
+                        self.impure_star[qual] = (
+                            kind, f"{short}() -> {desc}", f, ln)
+                        changed = True
+                        break
+
+    def impurity_of(self, qual: str) -> Optional[tuple]:
+        return self.impure_star.get(qual)

@@ -1,0 +1,96 @@
+"""Silent-swallow lint (rule ``silent-swallow``).
+
+An ``except Exception: pass`` in the data plane turns every future bug
+in its try-body into an invisible one: the object layer's retry paths,
+the chunk layer's cache/ingest machinery and the gateway's protocol
+handlers all degrade *by contract*, but a degrade that is neither
+counted, logged, nor classified is indistinguishable from working — the
+operator has no signal, and the next refactor widens the try without
+anyone noticing what it now hides.
+
+The rule, scoped to ``object/``, ``chunk/`` and ``gateway/``: a handler
+catching a BROAD type (bare ``except``, ``Exception``,
+``BaseException``) must do at least one of
+
+* re-raise (``raise`` / raise a classified error),
+* log (``logger.debug/info/warning/error/exception``),
+* count (a metric ``.inc()/.dec()/.observe()``),
+* or USE the caught exception (``except ... as e`` with ``e``
+  referenced — forwarding it into a future/fallback counts as
+  classification).
+
+Handlers for SPECIFIC exception types are exempt: naming the class IS
+the classification (``except NotFoundError: pass`` on an idempotent
+delete documents exactly what is being ignored).  The fix for a finding
+is never to delete the handler — it is to narrow the type, or add the
+one-line count/log that makes the degrade observable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Pass, SourceFile
+from .effects import LOG_OPS, METRIC_OPS
+
+SCOPED_DIRS = ("object/", "chunk/", "gateway/")
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _pkg_rel(sf: SourceFile) -> str:
+    return sf.rel.split("/", 1)[1] if "/" in sf.rel else sf.rel
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(getattr(e, "id", getattr(e, "attr", None)) in BROAD
+               for e in elts)
+
+
+def _handled(handler: ast.ExceptHandler) -> bool:
+    exc_name = handler.name
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if node.func.attr in LOG_OPS | METRIC_OPS:
+                return True
+        if exc_name and isinstance(node, ast.Name) \
+                and node.id == exc_name and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        rel = _pkg_rel(sf)
+        if not rel.startswith(SCOPED_DIRS):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _handled(node):
+                continue
+            findings.append(Finding(
+                sf.rel, node.lineno, "silent-swallow",
+                "broad except swallows silently — count it, log it, "
+                "narrow the exception type, or forward the error "
+                "(`as e` + use)"))
+    return findings
+
+
+PASS = Pass(
+    name="silent-swallow",
+    rules=("silent-swallow",),
+    run=run,
+    doc="object//chunk//gateway/ broad except handlers must count, log, "
+        "classify (narrow type) or forward the error",
+)

@@ -1,0 +1,334 @@
+"""Transaction rerun-purity lint (rule ``txn-purity``).
+
+Closures handed to the meta transaction seams rerun under optimistic
+conflict retry (``meta/redis_kv.py`` txn retries=50, ditto
+``tkv_client.py``; sqlite BUSY backoff reruns them too), so ANY effect a
+closure applies outside its transaction object double-applies on retry:
+a counter bump counts twice, an appended list grows twice, a submitted
+upload runs twice, a ``self`` field ends up holding a discarded
+attempt's value.  No functional test catches these — conflicts are rare
+until the exact production contention the ROADMAP is building toward.
+
+The rule: a closure that flows into ``txn/simple_txn/_txn/_rtxn/_etxn/
+_txn_notify`` (lambda argument, local ``def``, ``self.method`` refe-
+rence or module function) may only touch its transaction handle and its
+own locals:
+
+* no writes to ``self`` state and no mutating calls on it — transitively
+  through resolved same-class/module helpers (EffectModel.impure_star:
+  extracting the effect into a helper must not launder it);
+* no NON-IDEMPOTENT mutation of captured (enclosing-scope) names:
+  ``nonlocal`` writes, augmented assigns, ``captured.append(...)``,
+  ``del captured[...]``.  Plain last-write-wins assigns
+  (``captured.attr = v``, ``captured[k] = v``, ``self.X = v``) are
+  exempt — a rerun re-applies them to the same end state, which the
+  runtime twin verifies byte-for-byte;
+* no metric increments, object-store calls or scheduler dispatch.
+
+The one blessed idiom is RESET-FIRST accumulation: a closure whose
+FIRST statements clear a captured container (``del msgs[:]`` /
+``msgs.clear()``) may refill it — each rerun starts from empty, which is
+exactly how ``_txn_notify`` keeps post-commit notifications exactly-once
+(meta/kv.py).  The runtime twin (utils/txnwatch.py, JUICEFS_TXN_RERUN=1)
+covers what this walk cannot see: aliased state reached through plain
+locals, dynamic dispatch, nondeterminism.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Finding, Pass, SourceFile, attr_chain
+from .effects import MUTATING_METHODS, EffectModel
+from .locks import LockModel
+
+TXN_SINKS = {"txn", "simple_txn", "_txn", "_rtxn", "_etxn", "_txn_notify"}
+
+_KIND_MSG = {
+    "self-write": "writes self state",
+    "self-mutate": "mutates self state",
+    "global-write": "writes a module global",
+    "metric": "bumps a metric",
+    "io": "performs I/O or scheduler dispatch",
+}
+
+
+def _assigned_names(fn) -> set[str]:
+    """Names BOUND in `fn`'s own frame (params + plain assignments +
+    for/with/walrus/comprehension targets).  AugAssign targets are
+    deliberately excluded: `x += 1` on a name never plainly assigned is
+    a captured-state augment, not a local."""
+    out: set[str] = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        out.add(arg.arg)
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    if isinstance(fn, ast.Lambda):
+        return out
+    for node in EffectModel._own_nodes(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                out.update(_target_names(t))
+        elif isinstance(node, (ast.AnnAssign, ast.For, ast.AsyncFor)):
+            out.update(_target_names(node.target))
+        elif isinstance(node, ast.NamedExpr):
+            out.update(_target_names(node.target))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    out.update(_target_names(item.optional_vars))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            out.add(node.name)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                out.update(_target_names(gen.target))
+    return out
+
+
+def _target_names(t) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(t):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+def _reset_first(fn) -> set[str]:
+    """Captured names the closure clears up front (the blessed
+    reset-first accumulator idiom): `del X[:]`, `X.clear()`,
+    `X[:] = []` as a LEADING statement."""
+    out: set[str] = set()
+    body = getattr(fn, "body", None)
+    if not isinstance(body, list):
+        return out
+    for st in body:
+        if isinstance(st, ast.Delete) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Subscript) \
+                and isinstance(st.targets[0].value, ast.Name):
+            out.add(st.targets[0].value.id)
+        elif isinstance(st, ast.Expr) and isinstance(st.value, ast.Call) \
+                and isinstance(st.value.func, ast.Attribute) \
+                and st.value.func.attr == "clear" \
+                and isinstance(st.value.func.value, ast.Name):
+            out.add(st.value.func.value.id)
+        elif isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Subscript) \
+                and isinstance(st.targets[0].value, ast.Name):
+            out.add(st.targets[0].value.id)
+        else:
+            break
+    return out
+
+
+class _ClosureChecker:
+    def __init__(self, model: EffectModel, sf: SourceFile, cls,
+                 scope_qual: str):
+        self.model = model
+        self.sf = sf
+        self.cls = cls
+        self.scope = scope_qual
+        self.findings: list[Finding] = []
+
+    def _emit(self, line: int, what: str) -> None:
+        self.findings.append(Finding(
+            self.sf.rel, line, "txn-purity",
+            f"txn closure {what} — closures rerun under conflict retry; "
+            "move the effect after commit (or reset-first for "
+            "accumulators)"))
+
+    def check(self, fn, qual: Optional[str]) -> list[Finding]:
+        """fn: the Lambda/FunctionDef AST; qual: its EffectModel name
+        when it has one (nested defs, methods, module functions)."""
+        local = _assigned_names(fn)
+        nonlocals: set[str] = set()
+        if not isinstance(fn, ast.Lambda):
+            for node in EffectModel._own_nodes(fn):
+                if isinstance(node, ast.Nonlocal):
+                    nonlocals.update(node.names)
+        local -= nonlocals
+        exempt = _reset_first(fn)
+
+        # 1. the closure's own summarized effects (self state, metrics,
+        # I/O) — EffectModel already walked named closures; lambdas are
+        # walked here
+        if qual is not None and qual in self.model.funcs:
+            for eff in self.model.funcs[qual].effects:
+                self._emit(eff.line,
+                           f"{_KIND_MSG[eff.kind]} ({eff.desc})")
+
+        body_nodes = list(EffectModel._own_nodes(fn))
+        if isinstance(fn, ast.Lambda):
+            body_nodes = list(ast.walk(fn.body))
+
+        for node in body_nodes:
+            self._check_captured(node, local, nonlocals, exempt)
+            if isinstance(node, ast.Call):
+                if qual is None:
+                    self._lambda_call_effects(node)
+                self._check_transitive(node)
+        return self.findings
+
+    # -- captured-state mutation ------------------------------------------
+    def _check_captured(self, node, local: set, nonlocals: set,
+                        exempt: set) -> None:
+        if isinstance(node, ast.AugAssign):
+            t = node.target
+            if isinstance(t, ast.Name) and t.id not in local \
+                    and t.id not in exempt:
+                self._emit(node.lineno,
+                           f"augments captured name `{t.id}`")
+            elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                root = self._captured_root(t, local, exempt)
+                if root:
+                    self._emit(node.lineno,
+                               f"augments captured object `{root}`")
+        elif isinstance(node, ast.Assign):
+            # plain assigns are last-write-wins (rerun-idempotent) —
+            # only nonlocal rebinding is flagged, because its usual
+            # shape is an accumulator (`total = total + n`) in disguise
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in nonlocals:
+                    self._emit(node.lineno,
+                               f"assigns nonlocal `{t.id}`")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    root = self._captured_root(t, local, exempt)
+                    if root:
+                        self._emit(node.lineno,
+                                   f"deletes from captured object `{root}`")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATING_METHODS \
+                and isinstance(node.func.value, ast.Name):
+            name = node.func.value.id
+            if name not in local and name not in exempt:
+                self._emit(node.lineno,
+                           f"calls `{name}.{node.func.attr}(...)` on a "
+                           "captured name")
+
+    @staticmethod
+    def _captured_root(t, local: set, exempt: set) -> Optional[str]:
+        node = t
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        if isinstance(node, ast.Name) and node.id != "self" \
+                and node.id not in local and node.id not in exempt:
+            return node.id
+        return None  # self.* handled by EffectModel; locals are fine
+
+    # -- lambda direct effects (no EffectModel summary exists) -------------
+    def _lambda_call_effects(self, node: ast.Call) -> None:
+        from .effects import METRIC_OPS, STORE_OPS, SUBMIT_OPS
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        chain = attr_chain(fn)
+        if fn.attr in METRIC_OPS:
+            self._emit(node.lineno, f"bumps a metric (.{fn.attr}())")
+        elif chain and fn.attr in STORE_OPS and (
+                chain[-2] if len(chain) >= 2 else "") in ("storage",
+                                                          "_storage"):
+            self._emit(node.lineno, f"performs object-store {fn.attr}()")
+        elif fn.attr in SUBMIT_OPS:
+            self._emit(node.lineno, "dispatches scheduler work "
+                                    f"(.{fn.attr}())")
+
+    # -- transitive laundering through helpers -----------------------------
+    def _check_transitive(self, node: ast.Call) -> None:
+        callee = self.model.lock.resolve_callee(
+            node, self.sf, self.cls, scope=self.scope)
+        if callee is None:
+            return
+        hit = self.model.impurity_of(callee)
+        if hit is None:
+            return
+        kind, desc, f, ln = hit
+        short = callee.rsplit("::", 1)[-1]
+        self.findings.append(Finding(
+            self.sf.rel, node.lineno, "txn-purity",
+            f"txn closure calls {short}() which {_KIND_MSG[kind]} "
+            f"({desc} at {f}:{ln}) — rerun-unsafe through helpers"))
+
+
+def run(files: list[SourceFile], model: LockModel | None = None,
+        effects: EffectModel | None = None) -> list[Finding]:
+    effects = effects or EffectModel(files, model)
+    lock = effects.lock
+    findings: list[Finding] = []
+    by_file = {sf.rel: sf for sf in files}
+    seen: set[tuple] = set()
+    for qual in sorted(lock.funcs):
+        fi = lock.funcs[qual]
+        if fi.node is None:
+            continue
+        sf = by_file.get(fi.file)
+        if sf is None:
+            continue
+        for node in EffectModel._own_nodes(fi.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in TXN_SINKS):
+                continue
+            closure = _closure_arg(node)
+            if closure is None:
+                continue
+            fn_ast, cqual = _resolve_closure(closure, qual, fi, sf, lock)
+            if fn_ast is None:
+                continue
+            key = (sf.rel, getattr(fn_ast, "lineno", node.lineno), cqual)
+            if key in seen:
+                continue  # one closure, one analysis (many sink sites)
+            seen.add(key)
+            checker = _ClosureChecker(effects, sf, fi.cls,
+                                      cqual or qual)
+            findings.extend(checker.check(fn_ast, cqual))
+    return findings
+
+
+def _closure_arg(call: ast.Call):
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "fn":
+            return kw.value
+    return None
+
+
+def _resolve_closure(arg, qual: str, fi, sf: SourceFile, lock: LockModel):
+    """(ast, effect-model qual or None) for the closure expression, or
+    (None, None) when it cannot be resolved (params, foreign refs)."""
+    if isinstance(arg, ast.Lambda):
+        return arg, None
+    if isinstance(arg, ast.Name):
+        for cand in (f"{qual}.<{arg.id}>", f"{sf.rel}::{arg.id}"):
+            target = lock.funcs.get(cand)
+            if target is not None and target.node is not None:
+                return target.node, cand
+        return None, None
+    if isinstance(arg, ast.Attribute):
+        chain = attr_chain(arg)
+        if chain and chain[0] == "self" and len(chain) == 2 \
+                and fi.cls is not None:
+            target = lock.funcs.get(f"{fi.cls}.{chain[1]}")
+            if target is not None and target.node is not None:
+                return target.node, f"{fi.cls}.{chain[1]}"
+    return None, None
+
+
+PASS = Pass(
+    name="txn-purity",
+    rules=("txn-purity",),
+    run=run,
+    doc="closures passed to txn/simple_txn rerun under conflict retry: "
+        "no self/captured-state writes, metrics, I/O or dispatch — "
+        "transitively through helpers",
+)

@@ -84,6 +84,24 @@ TEST_MAP = {
                                      "-k", "not cli"],
     "juicefs_tpu/utils/lockwatch": ["tests/test_analysis.py",
                                     "-k", "watchdog"],
+    # ISSUE 12: the effect & error-path contract passes and their
+    # runtime twin.  Same posture as the ISSUE 7 set: the seeded
+    # fixtures + real-tree gates kill logic mutants without subprocess
+    # round-trips; the txnwatch drills (non-idempotent closure planted
+    # on every engine) kill harness mutants.
+    "tools/analyze/passes/effects": ["tests/test_analysis.py",
+                                     "-k", "txn_purity or degrade or "
+                                           "claim or swallow"],
+    "tools/analyze/passes/txn_purity": ["tests/test_analysis.py",
+                                        "-k", "txn_purity"],
+    "tools/analyze/passes/claims": ["tests/test_analysis.py",
+                                    "-k", "claim"],
+    "tools/analyze/passes/degrade": ["tests/test_analysis.py",
+                                     "-k", "degrade"],
+    "tools/analyze/passes/swallow": ["tests/test_analysis.py",
+                                     "-k", "swallow"],
+    "juicefs_tpu/utils/txnwatch": ["tests/test_analysis.py",
+                                   "-k", "txnwatch"],
     # ISSUE 9: meta lease cache + replica read routing. The coherence
     # drills (stale-read bound, negative-entry invalidation, victim
     # invalidation, replica-lag guard, TTL-0 passthrough) live in
