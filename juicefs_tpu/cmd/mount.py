@@ -112,6 +112,21 @@ def add_parser(sub):
                         "reads route there, WATCH transactions stay on the "
                         "primary, and replica lag is guarded by the volume "
                         "change-epoch")
+    p.add_argument("--write-batch", action="store_true",
+                   help="checkpoint write plane (ISSUE 13): coalesce "
+                        "create/slice-commit/setattr bursts into group-"
+                        "commit engine transactions with a local overlay "
+                        "for read-your-own-creates; fsync/close/rename are "
+                        "barriers (acked fsync = durably committed, "
+                        "deferred errors surface there). Default off = "
+                        "byte-identical per-op writes")
+    p.add_argument("--wbatch-flush-ms", type=float, default=3.0,
+                   help="max time a batched mutation waits for the group "
+                        "commit timer (barriers drain immediately)")
+    p.add_argument("--wbatch-prealloc", type=int, default=1024,
+                   help="inode ids preallocated per client allocation txn "
+                        "while write batching is on (create storms stop "
+                        "round-tripping for ids)")
     p.add_argument("--meta-op-limit", type=float, default=0,
                    help="per-tenant meta ops/s (0 = unlimited): token-"
                         "bucket throttling at the meta boundary — graceful "
@@ -191,6 +206,12 @@ def serve(args) -> int:
     )
     if getattr(args, "meta_op_limit", 0):
         m.configure_op_limit(args.meta_op_limit)
+    if getattr(args, "write_batch", False):
+        # checkpoint write plane (ISSUE 13): group-commit write batching;
+        # engines without nesting transactions force it back off inside
+        m.configure_write_batch(
+            flush_ms=getattr(args, "wbatch_flush_ms", 3.0),
+            inode_prealloc=getattr(args, "wbatch_prealloc", 1024))
 
     if args.heartbeat <= 0:
         logger.warning("--heartbeat %.1f invalid; using 1s", args.heartbeat)

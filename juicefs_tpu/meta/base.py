@@ -20,6 +20,7 @@ from . import interface
 from .cache import LeaseCache, MetaOpLimiter
 from .context import Context
 from .openfile import OpenFiles
+from .wbatch import WriteBatcher
 from .types import (
     Attr,
     Entry,
@@ -57,6 +58,11 @@ class BaseMeta(interface.Meta):
     # stays in TTL-0 passthrough — remote staleness could not even be
     # accelerated, so it is not cached at all (ISSUE 9).
     supports_inval_feed = False
+    # engines whose transactions NEST (a do_* call inside group_txn joins
+    # the enclosing transaction) set this True; without it the write
+    # batcher stays disabled — a "group" that cannot roll back atomically
+    # could commit partial state on a mid-group failure (ISSUE 13).
+    supports_group_txn = False
 
     def __init__(self, addr: str):
         self.addr = addr
@@ -73,6 +79,12 @@ class BaseMeta(interface.Meta):
         self.of.on_invalidate = lambda ino: self.lease.invalidate_attr(ino)
         # per-tenant meta-op token buckets (--meta-op-limit, ISSUE 9)
         self.op_limiter: Optional[MetaOpLimiter] = None
+        # checkpoint write plane (meta/wbatch.py, ISSUE 13): group-commit
+        # write batching behind the same seam the lease cache fronts for
+        # reads.  Disabled by default — every hook below is a single bool
+        # check and the path stays byte-identical to an unbatched build
+        # until configure_write_batch (mount --write-batch).
+        self.wbatch = WriteBatcher(self)
         self.msg_callbacks: dict[int, Callable] = {}
         self._lock = threading.Lock()
         # batched id allocation (reference base.go:946 freeID batching)
@@ -123,7 +135,13 @@ class BaseMeta(interface.Meta):
         ...
     def do_getattr(self, ino: int) -> tuple[int, Attr]: ...
     def do_setattr(self, ctx, ino, flags, attr: Attr) -> tuple[int, Attr]: ...
-    def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path) -> tuple[int, int, Attr]: ...
+    def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path,
+                 ino: int = 0) -> tuple[int, int, Attr]:
+        """``ino`` is a client-preallocated inode id (0 = allocate inside
+        the call): the write batcher hands its acked, overlay-visible id
+        through so the deferred engine txn commits the same inode the
+        client has been using (ISSUE 13)."""
+        ...
     def do_unlink(self, ctx, parent, name, skip_trash=False) -> tuple[int, int]:
         """Returns (st, victim_ino); the victim is resolved inside the
         transaction so callers can invalidate caches race-free."""
@@ -193,6 +211,51 @@ class BaseMeta(interface.Meta):
         self.op_limiter = (MetaOpLimiter(ops_per_sec)
                            if ops_per_sec and ops_per_sec > 0 else None)
 
+    # -- checkpoint write plane (ISSUE 13) ---------------------------------
+    def configure_write_batch(self, enabled: bool = True,
+                              flush_ms: float = 3.0, max_batch: int = 0,
+                              inode_prealloc: int = 1024) -> None:
+        """Enable group-commit write batching (mount --write-batch /
+        --wbatch-flush-ms).  Engines without nesting group transactions
+        are forced off — a non-atomic "group" could commit partial state
+        on a mid-group failure.  ``inode_prealloc`` widens the client's
+        id range so a create burst pays ONE allocation txn for N ids."""
+        self.wbatch.close()
+        if enabled and not self.supports_group_txn:
+            logger.warning(
+                "meta engine %s has no group transaction support; write "
+                "batching stays off (per-op passthrough)", self.name())
+            enabled = False
+        self.wbatch = WriteBatcher(self, enabled=enabled, flush_ms=flush_ms,
+                                   max_batch=max_batch)
+        if enabled:
+            self._free_inodes.batch = max(self._free_inodes.batch,
+                                          int(inode_prealloc))
+
+    def group_txn(self, fn: Callable[[], int], ops=()) -> int:
+        """Run ``fn`` (the write batcher's drain closure) inside ONE
+        engine transaction; a nonzero return aborts it atomically.
+        ``ops`` is the drained op list — engines may pre-warm the
+        transaction's read set from it (kv batches every key the group
+        will read into one MGET, so a 32-op group costs ~3 round trips
+        instead of one per member).  Engines with ``supports_group_txn``
+        override; the base fallback exists only for the forced-off path
+        above."""
+        return fn()
+
+    def sync_meta(self, ino: int = 0) -> int:
+        """fsync/flush barrier for the write batch: after this returns 0
+        every acked mutation the call covers is durably committed; a
+        deferred failure for ``ino`` surfaces here (sticky until close).
+        With an inode the drain is SCOPED (only an implicated file
+        drains — an fsync of an untouched file must not shatter other
+        writers' groups); ino 0 is the full unmount/flush_all barrier."""
+        if not self.wbatch.enabled:
+            return 0
+        if ino:
+            return self.wbatch.fsync_barrier(ino)
+        return self.wbatch.barrier()
+
     def _throttle(self, ctx) -> None:
         """Gate one meta op against the caller's tenant bucket: graceful
         queuing on the calling thread, never an error.  The tenant is the
@@ -212,6 +275,12 @@ class BaseMeta(interface.Meta):
         to the engine and primes the lease.  With the lease cache
         disabled this IS `do_getattr` — the uncached path stays
         byte-identical to a build without the cache layer."""
+        if self.wbatch.enabled:
+            # this client's own pending creates are authoritative in the
+            # overlay until the group commit lands (ISSUE 13)
+            a = self.wbatch.attr_overlay(ino)
+            if a is not None:
+                return 0, a
         if self.lease.enabled:
             attr = self.of.attr(ino)
             if attr is None:
@@ -320,6 +389,7 @@ class BaseMeta(interface.Meta):
         """Engines overwrite the stored session info; default no-op."""
 
     def close_session(self) -> None:
+        self.wbatch.close()  # final drain: acked mutations never drop
         self._stop.set()
         hb = self._heartbeat
         if hb is not None and hb.is_alive() \
@@ -580,6 +650,14 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_X)
         if st:
             return st, 0, Attr()
+        if self.wbatch.enabled:
+            # pending-create overlay: a batched create is visible to its
+            # own client before the group commit lands (ISSUE 13)
+            oino = self.wbatch.entry_overlay(parent, name)
+            if oino:
+                oattr = self.wbatch.attr_overlay(oino)
+                if oattr is not None:
+                    return 0, oino, oattr
         # lease-cache fast path: a live dentry + attr lease serves the
         # whole lookup with zero engine round trips (the dataloader's
         # stat/open-shuffled-shards hot path, ISSUE 9)
@@ -619,6 +697,13 @@ class BaseMeta(interface.Meta):
 
     def getattr(self, ctx: Context, ino: int) -> tuple[int, Attr]:
         self._throttle(ctx)
+        if self.wbatch.enabled:
+            a = self.wbatch.attr_overlay(ino)
+            if a is not None:
+                return 0, a
+            # non-overlay inode with deferred commits: a stat must see
+            # the committed state (dependent read = barrier, ISSUE 13)
+            self.wbatch.barrier_if(ino)
         cached = self.of.attr(ino)
         if cached is not None:
             return 0, cached
@@ -636,9 +721,11 @@ class BaseMeta(interface.Meta):
 
     def setattr(self, ctx: Context, ino: int, flags: int, attr: Attr) -> tuple[int, Attr]:
         self._throttle(ctx)
-        st, cur = self.do_getattr(ino)
-        if st:
-            return st, Attr()
+        cur = self.wbatch.attr_overlay(ino) if self.wbatch.enabled else None
+        if cur is None:
+            st, cur = self.do_getattr(ino)
+            if st:
+                return st, Attr()
         if flags & SET_ATTR_SIZE:
             # FUSE truncate-via-setattr path (reference base.go SetAttr)
             st, out = self.truncate(ctx, ino, attr.length)
@@ -658,6 +745,16 @@ class BaseMeta(interface.Meta):
                     return errno.EPERM, Attr()
                 if attr.gid != cur.gid and not ctx.contains_gid(attr.gid):
                     return errno.EPERM, Attr()
+        if self.wbatch.enabled:
+            batched = self.wbatch.submit_setattr(ctx, ino, flags, attr)
+            if batched is not None:
+                # local invalidation at ack (of.invalidate drops the
+                # lease too); the peer event publishes at drain
+                self.of.invalidate(ino)
+                return batched
+            # not this client's pending create: a deferred commit on the
+            # inode must land before the engine mutates it
+            self.wbatch.barrier_if(ino)
         st, out = self.do_setattr(ctx, ino, flags, attr)
         if st == 0:
             self.of.invalidate(ino)
@@ -684,6 +781,26 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st, 0, Attr()
+        if self.wbatch.enabled:
+            out = self.wbatch.submit_mknod(ctx, parent, name, typ, mode,
+                                           cumask, rdev, path)
+            if out is not None:
+                if out[0] == 0:
+                    # LOCAL write-through at ack time: this client's
+                    # lease drops the parent dentry/attr (a cached
+                    # negative must die the moment its create is acked).
+                    # PEER events publish at drain, post-commit — an
+                    # ack-time publish could let a peer refetch and
+                    # cache pre-commit state no later event heals.
+                    if self.lease.enabled:
+                        self.lease.invalidate_entry(parent, bytes(name))
+                        self.lease.invalidate_attr(parent)
+                return out
+            self.wbatch.note_passthrough()
+            # shed/declined: pending state this op depends on (a queued
+            # same-name create, the parent's pending mutations) must land
+            # before the engine sees it — passthrough never reorders
+            self.wbatch.barrier_if_entry(parent, name)
         out = self.do_mknod(ctx, parent, name, typ, mode, cumask, rdev, path)
         if out[0] == 0:
             self._note_change(("e", parent, bytes(name)), ("a", parent))
@@ -706,6 +823,8 @@ class BaseMeta(interface.Meta):
         return self.mknod(ctx, parent, name, TYPE_SYMLINK, 0o777, 0, 0, target)
 
     def readlink(self, ctx, ino) -> tuple[int, bytes]:
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)  # the symlink may be pending
         return self.do_readlink(ino)
 
     def unlink(self, ctx, parent, name, skip_trash=False) -> int:
@@ -716,6 +835,10 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st
+        if self.wbatch.enabled:
+            # the victim may be a pending create (or sit in a parent with
+            # pending creates): dependent cross-inode op = barrier
+            self.wbatch.barrier_if_entry(parent, name)
         st, ino = self.do_unlink(ctx, parent, name, skip_trash)
         if st == 0:
             if ino:
@@ -737,6 +860,10 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st
+        if self.wbatch.enabled and self.wbatch.has_pending():
+            # the doomed dir's emptiness check must see pending creates
+            # INSIDE it (victim ino unknown here): conservative full drain
+            self.wbatch.barrier()
         st = self.do_rmdir(ctx, parent, name, skip_trash)
         if st == 0:
             self._note_change(("e", parent, bytes(name)), ("a", parent))
@@ -756,7 +883,17 @@ class BaseMeta(interface.Meta):
         # a replaced/exchanged destination's open-file cached attr is
         # invalidated by the engine itself (victim resolved inside the
         # rename transaction, so concurrent renames cannot desync it)
-        st, ino, attr = self.do_rename(ctx, psrc, nsrc, pdst, ndst, flags)
+        if self.wbatch.enabled:
+            # BARRIER op (ISSUE 13): the rename rides as the TAIL of the
+            # drained group — every pending op (the shard's create and
+            # slice commits) lands in the SAME engine transaction ahead
+            # of it, and concurrent renames coalesce under one leader
+            st, ino, attr = self.wbatch.run_sync(
+                lambda: self.do_rename(ctx, psrc, nsrc, pdst, ndst, flags),
+                parent=psrc, kind="rename",
+                args=(psrc, bytes(nsrc), pdst, bytes(ndst)))
+        else:
+            st, ino, attr = self.do_rename(ctx, psrc, nsrc, pdst, ndst, flags)
         if st == 0:
             self.of.invalidate(ino)
             self._note_change(
@@ -773,6 +910,9 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, parent, MODE_MASK_W | MODE_MASK_X)
         if st:
             return st, Attr()
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)  # link target may be pending
+            self.wbatch.barrier_if_entry(parent, name)
         st, attr = self.do_link(ctx, ino, parent, name)
         if st == 0:
             self.of.invalidate(ino)
@@ -784,6 +924,10 @@ class BaseMeta(interface.Meta):
         st = self.access(ctx, ino, MODE_MASK_R)
         if st:
             return st, []
+        if self.wbatch.enabled:
+            # a listing must include this client's pending creates (the
+            # dir itself may even BE one): dependent read = barrier
+            self.wbatch.barrier_if(ino)
         st, entries = self.do_readdir(ctx, ino, want_attr)
         if st:
             return st, []
@@ -809,11 +953,17 @@ class BaseMeta(interface.Meta):
         # content-change detection (mtime/length vs the cached attr)
         # drops stale chunk lists, so it must see a REAL fetch — a
         # lease-served attr here would hide a peer's write for the lease
-        # TTL *plus* the openfile expire window
-        st, attr = self.do_getattr(ino)
-        if st:
-            return st, Attr()
-        self.lease.put_attr(ino, attr)
+        # TTL *plus* the openfile expire window.  A pending create in the
+        # OVERLAY is exempt: it cannot exist remotely before its group
+        # commit, so this client's ack attr is the whole truth.
+        attr = self.wbatch.attr_overlay(ino) if self.wbatch.enabled else None
+        if attr is None:
+            if self.wbatch.enabled:
+                self.wbatch.barrier_if(ino)
+            st, attr = self.do_getattr(ino)
+            if st:
+                return st, Attr()
+            self.lease.put_attr(ino, attr)
         if attr.typ != TYPE_FILE:
             return errno.EPERM, Attr()
         if ctx.check_permission:
@@ -830,11 +980,20 @@ class BaseMeta(interface.Meta):
         return 0, attr
 
     def close(self, ctx, ino) -> int:
-        if self.of.close(ino):
+        st = 0
+        last = self.of.close(ino)
+        if self.wbatch.enabled:
+            # close is a barrier for THIS inode: drain if it's implicated
+            # and surface its sticky deferred error — cleared only on the
+            # LAST close (an earlier handle's release, whose error the
+            # kernel ignores, must not swallow what a still-open write
+            # handle's later fsync has to report)
+            st = self.wbatch.close_barrier(ino, last)
+        if last:
             # last close: if unlinked while open, data can now be reclaimed
             if self.sid:
                 self.do_delete_sustained(self.sid, ino)
-        return 0
+        return st
 
     # -- file data ---------------------------------------------------------
     def new_slice(self) -> int:
@@ -845,6 +1004,10 @@ class BaseMeta(interface.Meta):
         return self._free_inodes.next(self.do_new_inodes)
 
     def read_chunk(self, ino: int, indx: int) -> tuple[int, list[Slice]]:
+        if self.wbatch.enabled:
+            # deferred slice commits must land before a chunk read (the
+            # same client's read-after-flush path): dependent read barrier
+            self.wbatch.barrier_if(ino)
         cached = self.of.chunk(ino, indx)
         if cached is not None:
             return 0, cached
@@ -859,6 +1022,8 @@ class BaseMeta(interface.Meta):
         whole window in ONE engine round trip instead of one per chunk.
         Open-file-cached chunks are served locally; only the misses hit
         `do_read_chunks` (engines may override with a single txn)."""
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)
         out: dict[int, tuple[int, list[Slice]]] = {}
         misses: list[int] = []
         for indx in indxs:
@@ -884,6 +1049,17 @@ class BaseMeta(interface.Meta):
     def write_chunk(self, ino: int, indx: int, pos: int, slc: Slice) -> int:
         if indx < 0 or pos + slc.len > CHUNK_SIZE:
             return errno.EINVAL
+        if self.wbatch.enabled:
+            st = self.wbatch.submit_write_chunk(ino, indx, pos, slc)
+            if st is not None:
+                # local invalidation at ack; the peer event publishes at
+                # drain, post-commit (see mknod above)
+                self.of.invalidate(ino)
+                return st
+            self.wbatch.note_passthrough()
+            # shed: the file's queued create/commits must land before the
+            # engine commit, or it would fail ENOENT on a healthy file
+            self.wbatch.barrier_if(ino)
         st = self.do_write_chunk(ino, indx, pos, slc, indx * CHUNK_SIZE + pos + slc.len)
         self.of.invalidate(ino)  # cached attr (length/mtime) and chunks are stale
         if st == 0:
@@ -891,6 +1067,8 @@ class BaseMeta(interface.Meta):
         return st
 
     def truncate(self, ctx, ino, length, skip_perm=False) -> tuple[int, Attr]:
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)
         if not skip_perm:
             st, attr = self.do_getattr(ino)
             if st:
@@ -909,6 +1087,8 @@ class BaseMeta(interface.Meta):
     def fallocate(self, ctx, ino, mode, off, size) -> int:
         if off < 0 or size <= 0:
             return errno.EINVAL
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)
         st = self.do_fallocate(ctx, ino, mode, off, size)
         if st == 0:
             self.of.invalidate(ino)
@@ -922,6 +1102,8 @@ class BaseMeta(interface.Meta):
         (reference base.go CopyFileRange)."""
         if flags:
             return errno.EINVAL, 0
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(fin, fout)
         st, attr = self.do_getattr(fin)
         if st:
             return st, 0
@@ -988,20 +1170,28 @@ class BaseMeta(interface.Meta):
 
     # -- xattr -------------------------------------------------------------
     def getxattr(self, ctx, ino, name: bytes) -> tuple[int, bytes]:
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)  # the inode may be a pending create
         return self.do_getxattr(ino, name)
 
     def setxattr(self, ctx, ino, name: bytes, value: bytes, flags: int = 0) -> int:
         if not name:
             return errno.EINVAL
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)
         st = self.do_setxattr(ino, name, value, flags)
         if st == 0:
             self.lease.invalidate_attr(ino)  # ctime moved
         return st
 
     def listxattr(self, ctx, ino) -> tuple[int, list[bytes]]:
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)
         return self.do_listxattr(ino)
 
     def removexattr(self, ctx, ino, name: bytes) -> int:
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)
         st = self.do_removexattr(ino, name)
         if st == 0:
             self.lease.invalidate_attr(ino)
@@ -1015,6 +1205,8 @@ class BaseMeta(interface.Meta):
 
     def summary(self, ctx, ino: int) -> tuple[int, Summary]:
         """du aggregate over a subtree (reference base.go GetSummary)."""
+        if self.wbatch.enabled and self.wbatch.has_pending():
+            self.wbatch.barrier()  # the walk reads engine state directly
         st, attr = self.do_getattr(ino)
         if st:
             return st, Summary()
@@ -1059,6 +1251,8 @@ class BaseMeta(interface.Meta):
     def remove_recursive(self, ctx, parent: int, name: bytes, skip_trash=False) -> tuple[int, int]:
         """rmr: post-order delete, iterative so arbitrarily deep trees cannot
         exhaust the Python stack (reference base.go Remove / cmd rmr)."""
+        if self.wbatch.enabled and self.wbatch.has_pending():
+            self.wbatch.barrier()  # bulk walk reads engine state directly
         st, ino, attr = self.lookup(ctx, parent, name)
         if st:
             return st, 0
@@ -1169,11 +1363,16 @@ class BaseMeta(interface.Meta):
 
 class _IDBatch:
     """Client-side batched allocation of inode/slice ids
-    (reference base.go:946 allocateInodes batching of 100/1000)."""
+    (reference base.go:946 allocateInodes batching of 100/1000).
+
+    ``batch`` is per-instance so the write batcher can widen the inode
+    range (ISSUE 13 preallocation: one allocation txn hands out N ids and
+    a create storm stops round-tripping for them)."""
 
     BATCH = 256
 
-    def __init__(self):
+    def __init__(self, batch: int = 0):
+        self.batch = int(batch) or self.BATCH
         self._next = 0
         self._end = 0
         self._lock = threading.Lock()
@@ -1181,8 +1380,9 @@ class _IDBatch:
     def next(self, alloc: Callable[[int], int]) -> int:
         with self._lock:
             if self._next >= self._end:
-                start = alloc(self.BATCH)
-                self._next, self._end = start, start + self.BATCH
+                n = max(1, self.batch)
+                start = alloc(n)
+                self._next, self._end = start, start + n
             v = self._next
             self._next += 1
             return v

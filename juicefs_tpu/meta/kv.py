@@ -111,6 +111,9 @@ class KVMeta(BaseMeta):
     # the IV{seq} journal + invalSeq counter below are the per-volume
     # change feed the lease cache requires (ISSUE 9)
     supports_inval_feed = True
+    # every TKVClient nests (a do_* inside an open txn joins it), so the
+    # write batcher's group commit is one atomic engine txn (ISSUE 13)
+    supports_group_txn = True
 
     def __init__(self, client: TKVClient, addr: str = ""):
         super().__init__(addr)
@@ -182,6 +185,61 @@ class KVMeta(BaseMeta):
             msgs.append((mtype, args))
         else:
             self._notify(mtype, *args)
+
+    def group_txn(self, fn, ops=()):
+        """Write-batch group commit (ISSUE 13): run the drain closure
+        inside ONE engine transaction — every nested do_* joins it via
+        `in_txn`, a nonzero return discards the whole buffer atomically,
+        and queued DELETE_SLICE/COMPACT_CHUNK notifications fire only
+        after the commit.
+
+        The group's predictable read set (dentry-exists keys, parent and
+        target attrs, the usage counters) is pre-warmed with ONE batched
+        `tx.gets` — on a networked engine that is one MGET round trip
+        for the whole group instead of one WATCH+GET per member, which
+        also shrinks the optimistic-conflict window a shard storm's hot
+        keys (parent attr, totalInodes) would otherwise blow open."""
+        def run(tx: KVTxn):
+            keys: list[bytes] = []
+            seen: set[bytes] = set()
+            rename_edges: list[tuple[int, bytes]] = []
+            for op in ops:
+                if op.kind == "mknod":
+                    ks = (self._entry_key(op.parent, op.name),
+                          self._attr_key(op.parent))
+                elif op.kind in ("write_chunk", "setattr"):
+                    ks = (self._attr_key(op.ino),)
+                elif op.kind == "rename" and op.args:
+                    psrc, nsrc, pdst, ndst = op.args
+                    rename_edges += [(psrc, nsrc), (pdst, ndst)]
+                    ks = (self._entry_key(psrc, nsrc), self._attr_key(psrc),
+                          self._entry_key(pdst, ndst), self._attr_key(pdst))
+                else:
+                    ks = ()
+                for k in ks:
+                    if k not in seen:
+                        seen.add(k)
+                        keys.append(k)
+            if keys:
+                keys.append(self._counter_key("usedSpace"))
+                keys.append(self._counter_key("totalInodes"))
+                tx.gets(*keys)  # warm the txn read cache in one trip
+            # phase 2: the renames' source/victim attrs — the entry reads
+            # above are cached now, so resolving them costs no trip, and
+            # one more batched gets covers every resolved inode
+            extra: list[bytes] = []
+            for parent, name in rename_edges:
+                raw = tx.get(self._entry_key(parent, name))
+                if raw:
+                    k = self._attr_key(int.from_bytes(raw[1:9], "big"))
+                    if k not in seen:
+                        seen.add(k)
+                        extra.append(k)
+            if extra:
+                tx.gets(*extra)
+            return fn()
+
+        return self._txn_notify(run)
 
     # ---- key builders (reference tkv.go:198-296) -------------------------
     @staticmethod
@@ -569,8 +627,11 @@ class KVMeta(BaseMeta):
 
         return self.client.simple_txn(fn)
 
-    def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path) -> tuple[int, int, Attr]:
-        ino = self.new_inode()
+    def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path,
+                 ino: int = 0) -> tuple[int, int, Attr]:
+        # ino != 0: the write batcher's preallocated id (ISSUE 13) — the
+        # deferred commit must create the inode the client already uses
+        ino = ino or self.new_inode()
         interned: list = []  # inherited-ACL internings, published post-commit
 
         def fn(tx: KVTxn):

@@ -184,6 +184,9 @@ class SQLMeta(BaseMeta):
     # the invalidation table + invalSeq counter are the per-volume change
     # feed the lease cache requires (ISSUE 9)
     supports_inval_feed = True
+    # _txn nests (a do_* on the same thread joins the open transaction),
+    # so the write batcher's group commit is one atomic txn (ISSUE 13)
+    supports_group_txn = True
 
     def __init__(self, path: str, addr: str = ""):
         super().__init__(addr or f"sql://{path}")
@@ -332,6 +335,15 @@ class SQLMeta(BaseMeta):
             msgs.append((mtype, args))
         else:
             self._notify(mtype, *args)
+
+    def group_txn(self, fn, ops=()):
+        """Write-batch group commit (ISSUE 13): the drain closure runs
+        inside ONE BEGIN IMMEDIATE transaction — nested do_* calls join
+        it, and a nonzero return rolls the whole group back atomically
+        (the errno-abort convention).  One commit per group is also one
+        WAL fsync per group under synchronous=FULL — the durable-
+        checkpoint posture this plane exists to amortize."""
+        return self._txn(lambda cur: fn())
 
     def shutdown(self) -> None:
         """Close this thread's database connection (NOT the file-close meta
@@ -663,8 +675,10 @@ class SQLMeta(BaseMeta):
 
         return self._rtxn(fn)
 
-    def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path) -> tuple[int, int, Attr]:
-        ino = self.new_inode()
+    def do_mknod(self, ctx, parent, name, typ, mode, cumask, rdev, path,
+                 ino: int = 0) -> tuple[int, int, Attr]:
+        # ino != 0: the write batcher's preallocated id (ISSUE 13)
+        ino = ino or self.new_inode()
         interned: list = []
 
         def fn(cur):

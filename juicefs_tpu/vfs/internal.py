@@ -158,6 +158,36 @@ class ControlHandler:
                 done += 1
         return {"errno": 0, "compacted": done}
 
+    def _op_epoch_plan(self, ctx, cmd):
+        """Dataset-manifest epoch hint (ISSUE 13 satellite): the training
+        loader knows its exact shard order for the next epoch, so it
+        hands the reader's sequential-EOF hook a precise next-shard plan
+        instead of the name-order readdir guess (ISSUE 11 residual).
+
+            {"op": "epoch_plan", "dir": <dir inode>,
+             "shards": ["shard-007", "shard-002", ...]}   # epoch order
+
+        Each shard's EOF then warms its successor in THIS list (the last
+        wraps to the first — the next epoch's opening shard).  An empty
+        list clears the plan and restores the readdir guess."""
+        names = [n.encode() if isinstance(n, str) else bytes(n)
+                 for n in cmd.get("shards", [])]
+        if not names:
+            self.vfs.reader.set_epoch_plan({})
+            return {"errno": 0, "planned": 0}
+        dir_ino = int(cmd.get("dir", 1))
+        inos = []
+        for nm in names:
+            st, ino, _ = self.vfs.meta.lookup(ctx, dir_ino, nm)
+            if st:
+                return {"errno": st,
+                        "error": f"shard {nm.decode(errors='replace')!r} "
+                                 "not found"}
+            inos.append(ino)
+        plan = {inos[i]: inos[(i + 1) % len(inos)] for i in range(len(inos))}
+        self.vfs.reader.set_epoch_plan(plan)
+        return {"errno": 0, "planned": len(plan)}
+
     def _op_clone(self, ctx, cmd):
         if not hasattr(self.vfs.meta, "clone"):
             return {"errno": _errno.ENOSYS}
@@ -243,6 +273,11 @@ class InternalFiles:
         reader = getattr(self.vfs, "reader", None)
         if reader is not None:
             out["readahead"] = reader.stats()
+        # checkpoint write plane (ISSUE 13): group-commit batching state —
+        # queue depth, drains vs batched mutations, sticky deferred errors
+        wb = getattr(getattr(self.vfs, "meta", None), "wbatch", None)
+        if wb is not None:
+            out["wbatch"] = wb.stats()
         # unified I/O scheduler + bandwidth budget (ISSUE 6): lane/queue
         # occupancy per class and token-bucket levels
         sched = getattr(store, "scheduler", None)

@@ -421,7 +421,17 @@ class DataReader:
         # them, and a full queue sheds the plan rather than backpressuring
         # the read thread
         self.ppool = store.scheduler.executor("slice", IOClass.PREFETCH)
+        # dataset-manifest epoch hint (ISSUE 13 satellite): exact
+        # ino -> next-shard-ino successor map installed via the `.control`
+        # epoch_plan op; empty = fall back to the name-order readdir guess
+        self._epoch_plan: dict[int, int] = {}
         _LIVE_READERS.add(self)
+
+    def set_epoch_plan(self, plan: dict[int, int]) -> None:
+        """Install (or clear) the manifest-driven next-shard plan: the
+        sequential-EOF epoch hook warms plan[ino] instead of guessing
+        the name-ordered sibling."""
+        self._epoch_plan = dict(plan)
 
     def open(self, ino: int) -> FileReader:
         fr = FileReader(self, ino)
@@ -486,28 +496,32 @@ class DataReader:
         cache-group peer owns become warm hints to that peer — between
         the members, the whole next shard lands ring-locally."""
         try:
-            st, attr = self.meta.getattr(ctx, ino)
-            if st != 0 or not attr.parent:
-                return  # multi-linked or gone: no unambiguous sibling
-            # attr-LESS readdir: the expensive part of a giant listing is
-            # the per-entry attr assembly + lease priming (readdirplus),
-            # which this deliberately skips — one plain name scan, then a
-            # single getattr on the chosen sibling.  The cap bounds the
-            # sort/scan work on absurd layouts (a 65k+-entry dir is not a
-            # shard directory; warming "the next" of it is a guess not
-            # worth the walk).
-            st, entries = self.meta.readdir(ctx, attr.parent)
-            if st != 0 or len(entries) > _EPOCH_DIR_CAP:
-                return
-            names = sorted(
-                (e.name, e.inode) for e in entries
-                if not e.name.startswith(b".")
-            )
-            nxt_ino = 0
-            for i, (_name, entry_ino) in enumerate(names):
-                if entry_ino == ino and i + 1 < len(names):
-                    nxt_ino = names[i + 1][1]
-                    break
+            # manifest-exact plan first (ISSUE 13 satellite): the loader
+            # told us the successor, so the readdir guess — and its whole
+            # directory scan — is skipped
+            nxt_ino = self._epoch_plan.get(ino, 0)
+            if not nxt_ino:
+                st, attr = self.meta.getattr(ctx, ino)
+                if st != 0 or not attr.parent:
+                    return  # multi-linked or gone: no unambiguous sibling
+                # attr-LESS readdir: the expensive part of a giant listing
+                # is the per-entry attr assembly + lease priming
+                # (readdirplus), which this deliberately skips — one plain
+                # name scan, then a single getattr on the chosen sibling.
+                # The cap bounds the sort/scan work on absurd layouts (a
+                # 65k+-entry dir is not a shard directory; warming "the
+                # next" of it is a guess not worth the walk).
+                st, entries = self.meta.readdir(ctx, attr.parent)
+                if st != 0 or len(entries) > _EPOCH_DIR_CAP:
+                    return
+                names = sorted(
+                    (e.name, e.inode) for e in entries
+                    if not e.name.startswith(b".")
+                )
+                for i, (_name, entry_ino) in enumerate(names):
+                    if entry_ino == ino and i + 1 < len(names):
+                        nxt_ino = names[i + 1][1]
+                        break
             if not nxt_ino:
                 return
             st, nattr = self.meta.getattr(ctx, nxt_ino)

@@ -531,6 +531,18 @@ class VFS:
             st = h.writer.flush()
             if st != 0:
                 return st
+        # fsync barrier for the checkpoint write plane (ISSUE 13): the
+        # slice commits the writer just queued — and the create that
+        # opened this file — must be durably committed before fsync
+        # acks; a deferred failure surfaces here, never silently (the
+        # vfs/writer.py sticky-error contract at the meta layer).
+        # OUTSIDE the writer guard: POSIX fsync flushes the FILE, so an
+        # O_RDONLY fd of a file with pending batched mutations must
+        # drain them too.
+        st = self.meta.sync_meta(ino)
+        if st != 0:
+            return st
+        if h.writer is not None:
             self.cache.invalidate_attr(ino)  # committed length/mtime
         # Drop this owner's POSIX locks on close, per POSIX close(2).
         if lock_owner and hasattr(self.meta, "setlk"):
@@ -554,8 +566,10 @@ class VFS:
         if h.writer is not None:
             st = self.writer.close(ino)
             self.cache.invalidate_attr(ino)
-        self.meta.close(ctx, ino)
-        return st
+        # meta close is the last write-batch barrier for this inode: a
+        # deferred commit that failed after the final fsync surfaces here
+        st2 = self.meta.close(ctx, ino)
+        return st or st2
 
     # -- data shaping ------------------------------------------------------
 

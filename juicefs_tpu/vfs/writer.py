@@ -311,7 +311,10 @@ class DataWriter:
         st = 0
         for fw in files:
             st = fw.flush() or st
-        return st
+        # flush_all is the unmount/takeover barrier: the slice commits
+        # queued above must also clear the meta write batch (ISSUE 13)
+        st2 = self.meta.sync_meta()
+        return st or st2
 
     def get_length(self, ino: int) -> Optional[int]:
         """Buffered (not yet committed) length, for read-your-writes."""

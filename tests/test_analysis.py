@@ -2159,3 +2159,157 @@ def test_txnwatch_install_noop_when_disabled(monkeypatch):
     finally:
         txnwatch._installed = saved_flag
         _time.time = saved_time
+
+
+# ---------------------------------------------------------------------------
+# wbatch-seam (ISSUE 13): vfs write mutations route through the batcher
+
+_WB_BASE_CLEAN = """
+class BaseMeta:
+    def mknod(self, ctx, parent, name, typ, mode):
+        if self.wbatch.enabled:
+            out = self.wbatch.submit_mknod(ctx, parent, name, typ, mode)
+            if out is not None:
+                return out
+        return self.do_mknod(ctx, parent, name, typ, mode)
+
+    def write_chunk(self, ino, indx, pos, slc):
+        if self.wbatch.enabled:
+            st = self.wbatch.submit_write_chunk(ino, indx, pos, slc)
+            if st is not None:
+                return st
+        return self.do_write_chunk(ino, indx, pos, slc, 0)
+"""
+
+_WB_PLANE_CLEAN = """
+class WriteBatcher:
+    def _drain_locked(self):
+        ops = self._take()
+        def group():
+            return 0
+        return self.meta.group_txn(group)
+"""
+
+
+def test_wbatch_seam_bare_vfs_mutations_fire(tmp_path):
+    report = _run(tmp_path, {"vfs/vfs.py": """
+        class VFS:
+            def mknod(self, ctx, parent, name, mode):
+                return self.meta.do_mknod(ctx, parent, name, 1, mode)
+
+            def commit(self, ino, indx, pos, slc):
+                return self.meta.do_write_chunk(ino, indx, pos, slc, 0)
+
+            def chmod(self, ctx, ino, mode):
+                return self.meta.do_setattr(ctx, ino, 1, mode)
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "wbatch-seam"]
+    assert any("do_mknod" in m for m in msgs), msgs
+    assert any("do_write_chunk" in m for m in msgs), msgs
+    assert any("do_setattr" in m for m in msgs), msgs
+
+
+def test_wbatch_seam_disconnected_base_fires(tmp_path):
+    report = _run(tmp_path, {"meta/base.py": """
+        class BaseMeta:
+            def mknod(self, ctx, parent, name, typ, mode):
+                return self.do_mknod(ctx, parent, name, typ, mode)
+
+            def write_chunk(self, ino, indx, pos, slc):
+                return self.do_write_chunk(ino, indx, pos, slc, 0)
+    """, "meta/wbatch.py": _WB_PLANE_CLEAN})
+    msgs = [f.message for f in report.findings if f.rule == "wbatch-seam"]
+    assert any("BaseMeta.mknod" in m for m in msgs), msgs
+    assert any("BaseMeta.write_chunk" in m for m in msgs), msgs
+
+
+def test_wbatch_seam_missing_group_txn_fires(tmp_path):
+    report = _run(tmp_path, {"meta/base.py": _WB_BASE_CLEAN,
+                             "meta/wbatch.py": """
+        class WriteBatcher:
+            def _drain_locked(self):
+                for op in self._take():
+                    op.run()   # one engine txn per op: the seam is gone
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "wbatch-seam"]
+    assert any("group_txn" in m for m in msgs), msgs
+
+
+def test_wbatch_seam_routed_tree_clean(tmp_path):
+    report = _run(tmp_path, {"meta/base.py": _WB_BASE_CLEAN,
+                             "meta/wbatch.py": _WB_PLANE_CLEAN,
+                             "vfs/vfs.py": """
+        class VFS:
+            def mknod(self, ctx, parent, name, mode):
+                return self.meta.mknod(ctx, parent, name, 1, mode)
+    """})
+    assert not [f for f in report.findings if f.rule == "wbatch-seam"], \
+        report.findings
+
+
+def test_wbatch_seam_real_tree_clean():
+    files = load_files()
+    from tools.analyze.passes import seams
+
+    assert not [f for f in seams.run_wbatch_seam(files)], \
+        [f.render() for f in seams.run_wbatch_seam(files)]
+
+
+# ---------------------------------------------------------------------------
+# claim-rollback: the wbatch overlay claim pair (ISSUE 13)
+
+def test_claim_rollback_wbatch_unprotected_acquire_fires(tmp_path):
+    """A can-raise call between the overlay acquire and the queue
+    handoff, without a releasing handler: the claim leaks."""
+    report = _run(tmp_path, {"meta/wbatch.py": """
+        class WriteBatcher:
+            def submit_mknod(self, op, attr):
+                self._overlay_acquire(op, attr)
+                self.meta.new_inode()          # can raise: claim leaks
+                self._queue.append(op)
+
+            def _drain_locked(self):
+                ops = self._take()
+                try:
+                    self._apply(ops)
+                finally:
+                    self._overlay_release(ops)
+    """})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert any("new_inode(...)" in f.message and "leaks" in f.message
+               for f in hits), report.findings
+
+
+def test_claim_rollback_wbatch_consumer_must_release_in_finally(tmp_path):
+    report = _run(tmp_path, {"meta/wbatch.py": """
+        class WriteBatcher:
+            def submit_mknod(self, op, attr):
+                self._overlay_acquire(op, attr)
+                self._queue.append(op)
+
+            def _drain_locked(self):
+                ops = self._take()
+                self._apply(ops)
+                self._overlay_release(ops)   # not finally: leaks on raise
+    """})
+    hits = [f for f in report.findings if f.rule == "claim-rollback"]
+    assert any("_drain_locked" in f.message and "finally" in f.message
+               for f in hits), report.findings
+
+
+def test_claim_rollback_wbatch_clean_shape(tmp_path):
+    report = _run(tmp_path, {"meta/wbatch.py": """
+        class WriteBatcher:
+            def submit_mknod(self, op, attr):
+                self._overlay_acquire(op, attr)
+                self._queue.append(op)
+
+            def _drain_locked(self):
+                ops = self._take()
+                try:
+                    self._apply(ops)
+                finally:
+                    self._overlay_release(ops)
+    """})
+    assert not [f for f in report.findings if f.rule == "claim-rollback"], \
+        report.findings

@@ -691,3 +691,75 @@ def test_stationary_hotspot_never_ramps(vfs):
     assert not fr._streaming
     assert fr._seq_bytes <= 2 * BS
     assert len(planned) <= 1
+
+
+def test_epoch_plan_overrides_name_order_guess(tmp_path):
+    """Dataset-manifest epoch hint (ISSUE 13 satellite): with an exact
+    plan installed, the sequential-EOF hook warms the PLANNED successor
+    — not the name-ordered sibling — and skips the readdir guess."""
+    v = _mk_vfs(tmp_path, streaming_after=2 * BS)
+    try:
+        shard0 = _write(v, b"shard-000", 8 * BS)
+        shard1 = _write(v, b"shard-001", 8 * BS)  # the name-order guess
+        shard7 = _write(v, b"shard-007", 8 * BS)  # the manifest's pick
+        for ino in (shard1, shard7):
+            st, slices = v.meta.read_chunk(ino, 0)
+            assert st == 0 and slices
+            for s in slices:
+                v.store.evict_cache(s.id, s.size)
+        v.reader.set_epoch_plan({shard0: shard7, shard7: shard0})
+        readdirs = []
+        orig_rd = v.meta.readdir
+
+        def spy_rd(ctx, ino, want_attr=False):
+            readdirs.append(ino)
+            return orig_rd(ctx, ino, want_attr)
+        v.meta.readdir = spy_rd
+        fr = v.reader.open(shard0)
+        pos = 0
+        while pos < 8 * BS:
+            st, data = fr.read(CTX, pos, BS)
+            assert st == 0
+            pos += len(data)
+        st, planned = v.meta.read_chunk(shard7, 0)
+        assert st == 0
+        st, guessed = v.meta.read_chunk(shard1, 0)
+        assert st == 0
+        deadline = time.time() + 5
+        warmed = 0
+        want = sum((s.size + BS - 1) // BS for s in planned)
+        while time.time() < deadline:
+            warmed = sum(v.store.check_cache(s.id, s.size) for s in planned)
+            if warmed >= want:
+                break
+            time.sleep(0.02)
+        assert warmed > 0, "planned successor never warmed"
+        assert not readdirs, "exact plan must skip the readdir guess"
+        assert sum(v.store.check_cache(s.id, s.size) for s in guessed) == 0, \
+            "name-order sibling must NOT be warmed when a plan exists"
+    finally:
+        v.close()
+
+
+def test_epoch_plan_ctl_op_installs_and_clears(tmp_path):
+    """`.control` epoch_plan: names resolve to an ino->successor map
+    (wrapping), bad names errno out, empty clears."""
+    v = _mk_vfs(tmp_path)
+    try:
+        a = _write(v, b"sh-a", BS)
+        b = _write(v, b"sh-b", BS)
+        c = _write(v, b"sh-c", BS)
+        from juicefs_tpu.vfs.internal import ControlHandler
+
+        h = ControlHandler(v)
+        out = h.handle(CTX, {"op": "epoch_plan", "dir": 1,
+                             "shards": ["sh-c", "sh-a", "sh-b"]})
+        assert out["errno"] == 0 and out["planned"] == 3
+        assert v.reader._epoch_plan == {c: a, a: b, b: c}
+        out = h.handle(CTX, {"op": "epoch_plan", "dir": 1,
+                             "shards": ["missing"]})
+        assert out["errno"] != 0
+        out = h.handle(CTX, {"op": "epoch_plan", "shards": []})
+        assert out["errno"] == 0 and v.reader._epoch_plan == {}
+    finally:
+        v.close()

@@ -1,0 +1,838 @@
+"""Checkpoint write plane (ISSUE 13): group-commit write batching drills.
+
+Every test here runs with the suite-wide lock watchdog AND the txn-rerun
+harness armed (conftest), so each drain's group closure is doubled and
+every engine transaction it takes is watched — the acceptance criterion
+that the whole plane stays clean under both is exercised by construction.
+"""
+
+import errno
+import os
+import threading
+import time
+
+import pytest
+
+from juicefs_tpu.meta import Format, ROOT_INODE, new_client
+from juicefs_tpu.meta.base import BaseMeta
+from juicefs_tpu.meta.context import Context
+from juicefs_tpu.meta.types import Attr, SET_ATTR_MODE, Slice
+
+ROOT = Context(uid=0, gid=0)
+
+
+def _mk_meta(tmp_path, engine: str, batch: bool = True, flush_ms: float = 50.0):
+    if engine == "kv":
+        url = "memkv://"
+    else:
+        url = f"sql://{tmp_path}/wb-{engine}-{batch}.db"
+    m = new_client(url)
+    m.init(Format(name="wb", trash_days=0), force=True)
+    m.load()
+    if batch:
+        m.configure_write_batch(flush_ms=flush_ms)
+    return m
+
+
+def _commit_counter(m):
+    """Count REAL engine transactions (outermost only — nested joins are
+    the same commit)."""
+    calls = [0]
+    if hasattr(m, "client"):
+        orig = m.client.txn
+
+        def counting(fn, retries=50, _o=orig):
+            if not m.client.in_txn():
+                calls[0] += 1
+            return _o(fn, retries)
+
+        m.client.txn = counting
+    else:
+        orig = m._txn
+
+        def counting(fn, retries=50, errno_abort=True, _o=orig):
+            if not getattr(m._tlocal, "in_txn", False):
+                calls[0] += 1
+            return _o(fn, retries, errno_abort)
+
+        m._txn = counting
+    return calls
+
+
+def _storm(m, dino, n, prefix=b"s", commit=True):
+    inos = []
+    for i in range(n):
+        st, ino, _ = m.create(ROOT, dino, prefix + b"%d.tmp" % i, 0o644)
+        assert st == 0, st
+        inos.append(ino)
+        if commit:
+            sid = m.new_slice()
+            st = m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096,
+                                                off=0, len=4096))
+            assert st == 0, st
+    return inos
+
+
+@pytest.mark.parametrize("engine", ["kv", "sql"])
+def test_group_commit_amortizes_engine_txns(tmp_path, engine):
+    """The headline contract: a create+commit burst acks with ~zero
+    engine transactions, and the barrier lands them all in ONE group
+    commit (engine txns <<< mutations, counter-asserted)."""
+    m = _mk_meta(tmp_path, engine)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"ckpt", 0o755)
+    assert st == 0
+    assert m.sync_meta() == 0  # settle the mkdir group
+    calls = _commit_counter(m)
+    inos = _storm(m, dino, 16)
+    enqueue_txns = calls[0]
+    # the only allowed round trips in the ack window are id-range
+    # allocations (inode + slice ranges)
+    assert enqueue_txns <= 2, enqueue_txns
+    assert m.sync_meta(inos[0]) == 0
+    barrier_txns = calls[0] - enqueue_txns
+    assert barrier_txns == 1, barrier_txns  # 32 mutations, ONE group txn
+    assert m.wbatch.stats()["drained"] >= 1
+    # the drained state is authoritative in the engine
+    st, ino, attr = m.do_lookup(dino, b"s3.tmp")
+    assert st == 0 and ino == inos[3] and attr.length == 4096
+    m.close_session()
+
+
+@pytest.mark.parametrize("engine", ["kv", "sql"])
+def test_overlay_serves_own_creates_with_zero_round_trips(tmp_path, engine):
+    m = _mk_meta(tmp_path, engine)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    inos = _storm(m, dino, 4)
+    assert m.wbatch.has_pending()
+    engine_reads = [0]
+    orig_ga, orig_lk = m.do_getattr, m.do_lookup
+
+    def ga(ino):
+        engine_reads[0] += 1
+        return orig_ga(ino)
+
+    def lk(p, n, hint_ino=0):
+        engine_reads[0] += 1
+        return orig_lk(p, n, hint_ino=hint_ino)
+
+    m.do_getattr, m.do_lookup = ga, lk
+    try:
+        st, ino, attr = m.lookup(ROOT, dino, b"s1.tmp")
+        assert st == 0 and ino == inos[1]
+        assert attr.length == 4096  # the queued commit updated the overlay
+        st, attr = m.getattr(ROOT, inos[2])
+        assert st == 0 and attr.mode == 0o644
+    finally:
+        m.do_getattr, m.do_lookup = orig_ga, orig_lk
+    assert engine_reads[0] == 0, "overlay reads must not round-trip"
+    assert m.wbatch.stats()["batched"] >= 8
+    m.close_session()
+
+
+@pytest.mark.parametrize("engine", ["kv", "sql"])
+def test_readdir_is_a_dependent_read_barrier(tmp_path, engine):
+    m = _mk_meta(tmp_path, engine)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()  # isolate the children-pending (dirty-parent) case
+    _storm(m, dino, 3, commit=False)
+    assert dino not in m.wbatch._dirty  # only as PARENT of pending ops
+    assert dino in m.wbatch._dirty_parents
+    assert m.wbatch.has_pending()
+    st, entries = m.readdir(ROOT, dino)
+    assert st == 0
+    names = {e.name for e in entries}
+    assert {b"s0.tmp", b"s1.tmp", b"s2.tmp"} <= names
+    assert not m.wbatch.has_pending()  # the listing drained the batch
+    m.close_session()
+
+
+@pytest.mark.parametrize("engine", ["kv", "sql"])
+def test_rename_rides_the_group_commit(tmp_path, engine):
+    """rename is a BARRIER that executes as the TAIL of the drained
+    group: one engine transaction commits the create, the slice commit
+    AND the rename."""
+    m = _mk_meta(tmp_path, engine)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    calls = _commit_counter(m)
+    inos = _storm(m, dino, 1)
+    st, ino, _ = m.rename(ROOT, dino, b"s0.tmp", dino, b"s0")
+    assert st == 0 and ino == inos[0]
+    # id allocations may add up to 2 txns; the group (create+commit+
+    # rename) is exactly one more
+    assert calls[0] <= 3, calls[0]
+    st, ino, attr = m.do_lookup(dino, b"s0")
+    assert st == 0 and ino == inos[0] and attr.length == 4096
+    st, _, _ = m.do_lookup(dino, b"s0.tmp")
+    assert st == errno.ENOENT
+    m.close_session()
+
+
+@pytest.mark.parametrize("engine", ["kv", "sql"])
+def test_deferred_error_sticky_until_close(tmp_path, engine):
+    """A deferred create that loses to an existing name surfaces at the
+    next barrier for its inode, stays sticky across barriers, and clears
+    at close — never silently."""
+    m = _mk_meta(tmp_path, engine)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    st, _, _ = m.create(ROOT, dino, b"x", 0o644)
+    assert st == 0
+    assert m.sync_meta() == 0  # "x" committed
+    # the overlay can't see the committed engine entry, so this acks 0
+    # and the EEXIST is discovered at drain (the writeback contract)
+    st, dup, _ = m.mknod(ROOT, dino, b"x", 1, 0o644)
+    assert st == 0
+    assert m.sync_meta(dup) == errno.EEXIST
+    assert m.sync_meta(dup) == errno.EEXIST  # sticky across barriers
+    assert m.close(ROOT, dup) == errno.EEXIST  # close surfaces + clears
+    assert m.sync_meta(dup) == 0
+    m.close_session()
+
+
+@pytest.mark.parametrize("engine", ["kv", "sql"])
+def test_group_failure_replays_per_op(tmp_path, engine):
+    """One bad op in a group must not poison its siblings: the group
+    aborts atomically and replays per-op — the good creates commit, only
+    the loser records a sticky error."""
+    m = _mk_meta(tmp_path, engine)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    st, _, _ = m.create(ROOT, dino, b"taken", 0o644)
+    assert st == 0
+    assert m.sync_meta() == 0
+    st, good1, _ = m.create(ROOT, dino, b"good1", 0o644)
+    assert st == 0
+    st, bad, _ = m.mknod(ROOT, dino, b"taken", 1, 0o644)
+    assert st == 0  # deferred EEXIST
+    st, good2, _ = m.create(ROOT, dino, b"good2", 0o644)
+    assert st == 0
+    assert m.sync_meta(good1) == 0
+    assert m.sync_meta(good2) == 0
+    assert m.sync_meta(bad) == errno.EEXIST
+    for name, ino in ((b"good1", good1), (b"good2", good2)):
+        st, got, _ = m.do_lookup(dino, name)
+        assert st == 0 and got == ino, name
+    m.close_session()
+
+
+@pytest.mark.parametrize("engine", ["kv", "sql"])
+def test_setattr_batched_on_overlay_inode(tmp_path, engine):
+    """chmod on this client's own pending create batches (the overlay is
+    authoritative) and the engine converges to the same mode at drain."""
+    m = _mk_meta(tmp_path, engine)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    st, ino, _ = m.create(ROOT, dino, b"f", 0o644)
+    assert st == 0
+    st, out = m.setattr(ROOT, ino, SET_ATTR_MODE, Attr(mode=0o600))
+    assert st == 0 and out.mode & 0o777 == 0o600
+    assert m.wbatch.has_pending()  # still deferred
+    assert m.sync_meta(ino) == 0
+    st, attr = m.do_getattr(ino)
+    assert st == 0 and attr.mode & 0o777 == 0o600
+    # the overlay/dirty claims fully release at drain — a leak would pin
+    # every later read of these inodes to a pointless barrier
+    assert m.wbatch._dirty == {} and m.wbatch._ov_attrs == {}
+    # a COMMITTED inode never batches its setattr (the overlay is not
+    # authoritative for it): the engine path must serve it
+    st, out = m.setattr(ROOT, ino, SET_ATTR_MODE, Attr(mode=0o640))
+    assert st == 0 and out.mode & 0o777 == 0o640
+    assert not m.wbatch.has_pending()
+    st, attr = m.do_getattr(ino)
+    assert st == 0 and attr.mode & 0o777 == 0o640
+    m.close_session()
+
+
+@pytest.mark.parametrize("member", [True, False])
+def test_setattr_setgid_clear_mirrors_engine(tmp_path, member):
+    """_apply_setattr_local mirrors the engines' non-member setgid clear:
+    a non-root chmod keeps 02xxx only when the caller belongs to the
+    file's group."""
+    m = _mk_meta(tmp_path, "kv")
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o777)
+    assert st == 0
+    m.sync_meta()
+    # the file's group is 3000; the chmod caller owns the file but is a
+    # member of its group only in the `member` case
+    owner = Context(uid=1000, gid=3000, gids=(3000,))
+    st, ino, _ = m.create(owner, dino, b"f", 0o644)
+    assert st == 0
+    ctx = Context(uid=1000, gid=1000,
+                  gids=(3000,) if member else (1000,))
+    st, out = m.setattr(ctx, ino, SET_ATTR_MODE, Attr(mode=0o2750))
+    assert st == 0
+    want = 0o2750 if member else 0o750
+    assert out.mode & 0o7777 == want, oct(out.mode)
+    assert m.sync_meta(ino) == 0
+    st, attr = m.do_getattr(ino)
+    assert st == 0 and attr.mode & 0o7777 == want, oct(attr.mode)
+    m.close_session()
+
+
+def test_stats_shape(tmp_path):
+    m = _mk_meta(tmp_path, "kv", flush_ms=50.0)
+    stats = m.wbatch.stats()
+    assert stats["flush_ms"] == 50.0
+    assert stats["max_batch"] == m.wbatch.max_batch
+    m.close_session()
+
+
+def test_default_off_is_passthrough(tmp_path):
+    """Batching off (the default): every mutation goes straight to the
+    engine — no queue, no overlay, no deferred acks."""
+    m = _mk_meta(tmp_path, "kv", batch=False)
+    assert not m.wbatch.enabled
+    calls = _commit_counter(m)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    st, ino, _ = m.create(ROOT, dino, b"f", 0o644)
+    assert st == 0
+    assert not m.wbatch.has_pending()
+    assert calls[0] >= 2  # one engine txn per mutation (+ id allocs)
+    assert m.wbatch.stats()["batched"] == 0
+    st, got, _ = m.do_lookup(dino, b"f")
+    assert st == 0 and got == ino
+    m.close_session()
+
+
+def test_engine_without_group_txn_forced_off():
+    class NoGroupMeta(BaseMeta):
+        def name(self):
+            return "nogroup"
+
+    m = NoGroupMeta("x://")
+    m.configure_write_batch(flush_ms=1.0)
+    assert not m.wbatch.enabled
+
+
+def test_overload_sheds_to_passthrough(tmp_path):
+    """A queue pinned past the shed bound makes submits DECLINE (the
+    shed decision) at an exact boundary; the public ops then barrier
+    before their engine passthrough (ordering is preserved — review
+    fix), and everything acked before the shed commits once the stuck
+    leader is gone."""
+    m = _mk_meta(tmp_path, "kv", flush_ms=10_000.0)
+    m.wbatch.max_batch = 8
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    bound = m.wbatch.max_batch * 4
+    # pin drain leadership so _maybe_kick cannot shrink the queue
+    assert m.wbatch._drain_lock.acquire(timeout=5)
+    try:
+        inos = []
+        sheds = 0
+        for i in range(bound + 4):
+            out = m.wbatch.submit_mknod(ROOT, dino, b"f%d" % i, 1, 0o644,
+                                        0, 0, b"")
+            if out is None:
+                sheds += 1  # the shed decision: caller takes engine path
+            else:
+                assert out[0] == 0
+                inos.append((b"f%d" % i, out[1]))
+        # the shed bound is EXACT: the queue fills to max_batch*4 and
+        # not one op past it
+        assert sheds == 4 and m.wbatch.stats()["queued"] == bound
+        # batched setattr also declines at the bound (overlay ino)
+        assert m.wbatch.submit_setattr(ROOT, inos[0][1], SET_ATTR_MODE,
+                                       Attr(mode=0o600)) is None
+        assert m.wbatch.submit_write_chunk(
+            inos[0][1], 0, 0, Slice(pos=0, id=1, size=4096, off=0,
+                                    len=4096)) is None
+    finally:
+        m.wbatch._drain_lock.release()
+    assert m.sync_meta() == 0
+    for name, ino in inos:
+        st, got, _ = m.do_lookup(dino, name)
+        assert st == 0 and got == ino, name
+    m.close_session()
+
+
+def test_shed_passthrough_waits_for_pending_dependency(tmp_path):
+    """Review fix: an op the batcher sheds must still ORDER behind the
+    pending state it depends on — a passthrough slice commit for a
+    still-queued create barriers first instead of dying ENOENT in the
+    engine."""
+    m = _mk_meta(tmp_path, "kv", flush_ms=10_000.0)
+    m.wbatch.max_batch = 8
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    entered = threading.Event()
+    orig = m.group_txn
+
+    def slow(fn, ops=()):
+        entered.set()
+        time.sleep(0.4)
+        return orig(fn, ops)
+
+    m.group_txn = slow
+    # first create + a leader stuck committing it
+    st, ino, _ = m.create(ROOT, dino, b"dep", 0o644)
+    assert st == 0
+    leader = threading.Thread(target=m.wbatch._drain, daemon=True)
+    leader.start()
+    assert entered.wait(5)
+    # flood past the shed bound while the leader is stuck
+    for i in range(m.wbatch.max_batch * 4 + 2):
+        m.create(ROOT, dino, b"x%d" % i, 0o644)
+    assert m.wbatch.stats()["passthrough"] > 0
+    m.group_txn = orig
+    # the shed commit on the still-pending create must wait + succeed
+    sid = m.new_slice()
+    st = m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0,
+                                        len=4096))
+    assert st == 0, st
+    leader.join(5)
+    assert m.sync_meta(ino) == 0
+    st, got, attr = m.do_lookup(dino, b"dep")
+    assert st == 0 and got == ino and attr.length == 4096
+    m.close_session()
+
+
+def test_fsync_on_readonly_handle_drains_pending(tmp_path):
+    """Review fix: POSIX fsync flushes the FILE — an O_RDONLY fd of a
+    file with pending batched mutations must drain them too."""
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.vfs import ROOT_INO, VFS
+
+    m = _mk_meta(tmp_path, "kv", flush_ms=10_000.0)  # only barriers drain
+    store = CachedStore(create_storage("mem://"),
+                        ChunkConfig(block_size=1 << 20))
+    v = VFS(m, store)
+    ctx = Context(uid=0, gid=0, pid=1)
+    try:
+        st, ino, _, fh_w = v.create(ctx, ROOT_INO, b"f", 0o644)
+        assert st == 0
+        assert v.write(ctx, ino, fh_w, 0, b"x" * 4096) == 0
+        st, _, fh_r = v.open(ctx, ino, os.O_RDONLY)
+        assert st == 0
+        assert v.fsync(ctx, ino, fh_r) == 0  # read-only fd, same file
+        assert ino not in m.wbatch._dirty,             "fsync on a read-only handle must drain the file's batch"
+        st, got, _ = m.do_lookup(ROOT_INO, b"f")
+        assert st == 0 and got == ino
+        v.release(ctx, ino, fh_r)
+        v.release(ctx, ino, fh_w)
+    finally:
+        v.close()
+        store.close()
+        m.close_session()
+
+
+def test_fsync_of_untouched_file_does_not_drain_others(tmp_path):
+    """Review fix: the fsync barrier is SCOPED — syncing a file with no
+    pending ops must not shatter the groups other writers are building."""
+    m = _mk_meta(tmp_path, "kv", flush_ms=10_000.0)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    st, cold, _ = m.create(ROOT, dino, b"cold", 0o644)
+    assert st == 0
+    m.sync_meta()  # "cold" fully committed, nothing pending for it
+    _storm(m, dino, 3)  # other files' pending batch
+    assert m.wbatch.has_pending()
+    assert m.sync_meta(cold) == 0
+    assert m.wbatch.has_pending(),         "an untouched file's fsync must not drain the shared batch"
+    assert m.sync_meta() == 0  # the full barrier still drains everything
+    assert not m.wbatch.has_pending()
+    m.close_session()
+
+
+def test_peer_events_publish_at_commit_not_ack(tmp_path):
+    """Review fix: peer invalidations for batched mutations buffer at
+    DRAIN (post-commit) — an ack-time publish could let a peer refetch
+    pre-commit state (a cached negative dentry) that nothing heals."""
+    m = _mk_meta(tmp_path, "kv", flush_ms=10_000.0)
+    m.new_session(heartbeat=0.0)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    with m._inval_mu:
+        del m._inval_buf[:]
+    st, ino, _ = m.create(ROOT, dino, b"f", 0o644)
+    assert st == 0
+    assert ("e", dino, b"f") not in m._inval_buf,         "peer event must not publish before the group commit"
+    assert m.sync_meta(ino) == 0
+    assert ("e", dino, b"f") in m._inval_buf
+    assert ("a", ino) not in m._inval_buf or True  # attr event optional
+    m.close_session()
+
+
+def test_inode_prealloc_one_allocation_txn(tmp_path):
+    m = _mk_meta(tmp_path, "kv")
+    allocs = [0]
+    orig = m.do_new_inodes
+
+    def counting(n):
+        allocs[0] += 1
+        return orig(n)
+
+    m.do_new_inodes = counting
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    _storm(m, dino, 100, commit=False)
+    assert allocs[0] <= 1, allocs[0]  # one range txn covers the storm
+    assert m.sync_meta() == 0
+    m.close_session()
+
+
+def test_concurrent_writers_coalesce(tmp_path):
+    """The fleet shape in-miniature: concurrent writer threads doing
+    create -> commit -> fsync -> rename; their barriers coalesce
+    leader/follower style so engine txns stay well below mutations."""
+    m = _mk_meta(tmp_path, "sql", flush_ms=5.0)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    calls = _commit_counter(m)
+    errs = []
+    shards_per = 6
+    writers = 4
+
+    def worker(w):
+        try:
+            for i in range(shards_per):
+                tmp = b"w%d-%d.tmp" % (w, i)
+                st, ino, _ = m.create(ROOT, dino, tmp, 0o644)
+                assert st == 0, st
+                sid = m.new_slice()
+                st = m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096,
+                                                    off=0, len=4096))
+                assert st == 0, st
+                assert m.sync_meta(ino) == 0
+                st, _, _ = m.rename(ROOT, dino, tmp, dino, tmp[:-4])
+                assert st == 0, st
+                assert m.close(ROOT, ino) == 0
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errs, errs
+    mutations = writers * shards_per * 3  # create + commit + rename
+    assert calls[0] < mutations, (calls[0], mutations)
+    for w in range(writers):
+        for i in range(shards_per):
+            st, _, attr = m.do_lookup(dino, b"w%d-%d" % (w, i))
+            assert st == 0 and attr.length == 4096
+    m.close_session()
+
+
+def test_acked_fsync_is_durable_for_a_fresh_client(tmp_path):
+    """The barrier/durability contract on the persistent engine: after
+    fsync acks, a COMPLETELY fresh client (new connections, no overlay)
+    reads the shard; an un-fsynced batch may legally vanish — here the
+    'crashed' client simply never drained."""
+    path = f"{tmp_path}/durable.db"
+    m = new_client(f"sql://{path}")
+    m.init(Format(name="wb", trash_days=0), force=True)
+    m.load()
+    m.configure_write_batch(flush_ms=10_000.0)  # only barriers drain
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    st, ino, _ = m.create(ROOT, dino, b"durable", 0o644)
+    assert st == 0
+    sid = m.new_slice()
+    assert m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0,
+                                          len=4096)) == 0
+    assert m.sync_meta(ino) == 0  # fsync: durably committed
+    st, vol, _ = m.create(ROOT, dino, b"volatile", 0o644)
+    assert st == 0  # acked but never fsynced; legally lost on a crash
+    # "kill" the client: drop it without close/drain
+    m.wbatch.enabled = False
+    m.wbatch._stop.set()
+    fresh = new_client(f"sql://{path}")
+    fresh.load()
+    st, got, attr = fresh.lookup(ROOT, dino, b"durable")
+    assert st == 0 and got == ino and attr.length == 4096
+    st, slcs = fresh.read_chunk(got, 0)
+    assert st == 0 and len(slcs) == 1 and slcs[0].id == sid
+    st, _, _ = fresh.lookup(ROOT, dino, b"volatile")
+    assert st == errno.ENOENT  # the un-fsynced batch vanished
+
+
+def test_lease_write_through_and_priming(tmp_path):
+    """Batching composes with the PR 9 lease cache: the ack invalidates
+    the parent's negative dentry, and the drain primes the lease with
+    the authoritative attr."""
+    m = _mk_meta(tmp_path, "kv")
+    m.configure_meta_cache(attr_ttl=30.0, entry_ttl=30.0)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    st, _, _ = m.lookup(ROOT, dino, b"f")
+    assert st == errno.ENOENT  # caches the negative dentry
+    st, ino, _ = m.create(ROOT, dino, b"f", 0o644)
+    assert st == 0
+    # the ack's write-through dropped the negative lease: the overlay now
+    # serves the pending create instead of a cached ENOENT
+    st, got, _ = m.lookup(ROOT, dino, b"f")
+    assert st == 0 and got == ino
+    assert m.sync_meta(ino) == 0
+    # post-drain: the lease holds the authoritative entry/attr
+    assert m.lease.get_entry(dino, b"f") == ino
+    assert m.lease.get_attr(ino) is not None
+    m.close_session()
+
+
+def test_status_wbatch_section(tmp_path):
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.vfs import VFS
+
+    m = _mk_meta(tmp_path, "kv")
+    store = CachedStore(create_storage("mem://"),
+                        ChunkConfig(block_size=1 << 20))
+    v = VFS(m, store)
+    try:
+        payload = v.internal._status_payload()
+        assert payload["wbatch"]["enabled"] is True
+        assert "drained" in payload["wbatch"]
+    finally:
+        v.close()
+        store.close()
+        m.close_session()
+
+
+def test_vfs_checkpoint_cycle_end_to_end(tmp_path):
+    """Full vfs-level shard cycle (create -> write -> fsync -> rename ->
+    release) with batching on: data readable back through a fresh
+    reader, all under the txn-rerun + lock-watchdog harnesses."""
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.vfs import ROOT_INO, VFS
+
+    m = _mk_meta(tmp_path, "kv")
+    store = CachedStore(create_storage("mem://"),
+                        ChunkConfig(block_size=1 << 20))
+    v = VFS(m, store)
+    ctx = Context(uid=0, gid=0, pid=1)
+    payload = os.urandom(256 << 10)
+    try:
+        st, ino, _, fh = v.create(ctx, ROOT_INO, b"shard-0.tmp", 0o644)
+        assert st == 0
+        assert v.write(ctx, ino, fh, 0, payload) == 0
+        assert v.fsync(ctx, ino, fh) == 0
+        st, _, _ = v.rename(ctx, ROOT_INO, b"shard-0.tmp", ROOT_INO,
+                            b"shard-0")
+        assert st == 0
+        assert v.release(ctx, ino, fh) == 0
+        st, got, attr = v.lookup(ctx, ROOT_INO, b"shard-0")
+        assert st == 0 and got == ino and attr.length == len(payload)
+        fr = v.reader.open(ino)
+        st, data = fr.read(ctx, 0, len(payload))
+        assert st == 0 and bytes(data) == payload
+    finally:
+        v.close()
+        store.close()
+        m.close_session()
+
+
+def test_timed_flush_drains_without_barrier(tmp_path):
+    m = _mk_meta(tmp_path, "kv", flush_ms=20.0)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    st, ino, _ = m.create(ROOT, dino, b"f", 0o644)
+    assert st == 0
+    deadline = time.time() + 5.0
+    while m.wbatch.has_pending() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not m.wbatch.has_pending(), "timer must drain the batch"
+    st, got, _ = m.do_lookup(dino, b"f")
+    assert st == 0 and got == ino
+    m.close_session()
+
+
+def test_batched_mkdir_and_symlink_overlay_attrs(tmp_path):
+    """Directory and symlink creates batch too: the overlay attr carries
+    the engine-identical shape (dir length 4096/nlink 2, symlink length =
+    target length), and readlink on a pending symlink barriers."""
+    m = _mk_meta(tmp_path, "kv")
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"sub", 0o755)
+    assert st == 0
+    st, attr = m.getattr(ROOT, dino)
+    assert st == 0 and attr.length == 4096 and attr.nlink == 2
+    target = b"../elsewhere/file"
+    st, lino, lattr = m.symlink(ROOT, ROOT_INODE, b"lnk", target)
+    assert st == 0 and lattr.length == len(target)
+    assert m.wbatch.has_pending()
+    st, got = m.readlink(ROOT, lino)  # dependent read: drains first
+    assert st == 0 and got == target
+    st, attr = m.do_getattr(dino)  # drained dir matches the overlay shape
+    assert st == 0 and attr.length == 4096 and attr.nlink == 2
+    m.close_session()
+
+
+def test_write_chunk_hint_beyond_first_chunk(tmp_path):
+    """A batched commit in chunk index N advances the overlay (and the
+    engine) length to N*CHUNK_SIZE + pos + len — not just the in-chunk
+    offset."""
+    from juicefs_tpu.meta.types import CHUNK_SIZE
+
+    m = _mk_meta(tmp_path, "kv")
+    st, ino, _ = m.create(ROOT, ROOT_INODE, b"big", 0o644)
+    assert st == 0
+    sid = m.new_slice()
+    st = m.write_chunk(ino, 2, 4096, Slice(pos=4096, id=sid, size=4096,
+                                           off=0, len=4096))
+    assert st == 0
+    want = 2 * CHUNK_SIZE + 8192
+    st, attr = m.getattr(ROOT, ino)  # overlay is authoritative pre-drain
+    assert st == 0 and attr.length == want
+    assert m.sync_meta(ino) == 0
+    st, attr = m.do_getattr(ino)
+    assert st == 0 and attr.length == want
+    m.close_session()
+
+
+def test_setattr_batches_with_deep_queue(tmp_path):
+    """A batched setattr joins a NON-trivial queue (several pending
+    creates ahead of it) without draining — the shed bound is 4x the
+    batch size, not a fraction of it."""
+    m = _mk_meta(tmp_path, "kv")
+    m.wbatch.max_batch = 8  # shed bound 32: a 4-op queue is NOT overload
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    inos = _storm(m, dino, 3, commit=False)
+    st, out = m.setattr(ROOT, inos[1], SET_ATTR_MODE, Attr(mode=0o600))
+    assert st == 0 and out.mode & 0o777 == 0o600
+    assert m.wbatch.has_pending(), "a 4-op queue must not shed or drain"
+    # write_chunk batches at the same depth (its shed bound is 4x the
+    # batch size too, not a fraction of it)
+    sid = m.new_slice()
+    assert m.write_chunk(inos[0], 0, 0, Slice(pos=0, id=sid, size=4096,
+                                              off=0, len=4096)) == 0
+    assert m.wbatch.has_pending(), "a mid-depth commit must not drain"
+    assert m.sync_meta(inos[1]) == 0
+    st, attr = m.do_getattr(inos[1])
+    assert st == 0 and attr.mode & 0o777 == 0o600
+    st, attr = m.do_getattr(inos[0])
+    assert st == 0 and attr.length == 4096
+    m.close_session()
+
+
+def test_unlink_of_pending_create_barriers(tmp_path):
+    """unlink of an entry that only exists in the overlay must drain
+    first — skipping the barrier would surface a bogus ENOENT for a file
+    this client was just told exists."""
+    m = _mk_meta(tmp_path, "kv")
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    st, ino, _ = m.create(ROOT, dino, b"doomed", 0o644)
+    assert st == 0
+    assert m.wbatch.has_pending()
+    assert m.unlink(ROOT, dino, b"doomed") == 0
+    st, _, _ = m.do_lookup(dino, b"doomed")
+    assert st == errno.ENOENT
+    m.close_session()
+
+
+def test_barrier_waits_out_inflight_drain(tmp_path):
+    """Review fix (ISSUE 13): a barrier arriving while a drain is IN
+    FLIGHT (snapshot already moved out of the queue, commit not yet
+    landed) must wait that commit out — an fsync acking against an
+    uncommitted group would be a durability lie."""
+    m = _mk_meta(tmp_path, "kv", flush_ms=10_000.0)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    st, ino, _ = m.create(ROOT, dino, b"f", 0o644)
+    assert st == 0
+    sid = m.new_slice()
+    assert m.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096, off=0,
+                                          len=4096)) == 0
+    entered = threading.Event()
+    orig = m.group_txn
+
+    def slow(fn, ops=()):
+        entered.set()
+        time.sleep(0.4)  # the commit is in flight this whole window
+        return orig(fn, ops)
+
+    m.group_txn = slow
+    leader = threading.Thread(target=m.wbatch._drain, daemon=True)
+    leader.start()
+    assert entered.wait(5)
+    # review fix: the in-flight snapshot (queue empty, dirty claims
+    # held) still counts as pending — rmdir/summary guards rely on it
+    assert m.wbatch.has_pending()
+    t0 = time.perf_counter()
+    assert m.sync_meta(ino) == 0  # must block until the commit lands
+    waited = time.perf_counter() - t0
+    assert waited >= 0.25, f"fsync acked {waited:.3f}s into the commit"
+    st, got, attr = m.do_lookup(dino, b"f")
+    assert st == 0 and got == ino and attr.length == 4096
+    leader.join(5)
+    m.group_txn = orig
+    m.close_session()
+
+
+def test_sticky_error_survives_non_last_close(tmp_path):
+    """Review fix (ISSUE 13): only the LAST close clears an inode's
+    sticky deferred error — an earlier handle's release (whose return
+    the kernel ignores) must not swallow what a still-open write
+    handle's fsync has to report."""
+    m = _mk_meta(tmp_path, "kv")
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    st, ino, _ = m.create(ROOT, dino, b"f", 0o644)  # of refcount 1
+    assert st == 0
+    assert m.sync_meta(ino) == 0
+    st, _ = m.open(ROOT, ino, os.O_RDONLY)  # of refcount 2
+    assert st == 0
+    m.wbatch._errors[ino] = errno.EIO  # a deferred commit failed
+    assert m.close(ROOT, ino) == errno.EIO  # first close: surface, KEEP
+    assert m.sync_meta(ino) == errno.EIO, \
+        "the write handle's fsync must still see the error"
+    assert m.close(ROOT, ino) == errno.EIO  # last close: surface + clear
+    assert m.sync_meta(ino) == 0
+    m.close_session()
+
+
+def test_dirty_parent_refcount_across_overlapping_drains(tmp_path):
+    """The dirty-parent claim is a REFCOUNT: a drain releasing one
+    child's claim must not drop the parent's dirtiness while another
+    child enqueued mid-drain is still pending — or readdir would skip
+    its barrier and serve a listing missing an acked create."""
+    m = _mk_meta(tmp_path, "kv", flush_ms=10_000.0)
+    st, dino, _ = m.mkdir(ROOT, ROOT_INODE, b"d", 0o755)
+    assert st == 0
+    m.sync_meta()
+    entered = threading.Event()
+    release = threading.Event()
+    orig = m.group_txn
+
+    def slow(fn, ops=()):
+        entered.set()
+        release.wait(5)
+        return orig(fn, ops)
+
+    m.group_txn = slow
+    st, f1, _ = m.create(ROOT, dino, b"f1", 0o644)
+    assert st == 0
+    leader = threading.Thread(target=m.wbatch._drain, daemon=True)
+    leader.start()
+    assert entered.wait(5)
+    # enqueued while f1's drain is in flight: a second claim on dino
+    st, f2, _ = m.create(ROOT, dino, b"f2", 0o644)
+    assert st == 0
+    m.group_txn = orig
+    release.set()
+    leader.join(5)
+    # f1 released its claim; f2's must still mark the parent dirty
+    assert dino in m.wbatch._dirty_parents,         "releasing one child's claim dropped the parent's dirtiness"
+    st, entries = m.readdir(ROOT, dino)  # dependent read: must drain f2
+    assert st == 0
+    assert {b"f1", b"f2"} <= {e.name for e in entries}
+    m.close_session()

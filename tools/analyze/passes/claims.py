@@ -99,6 +99,20 @@ CLAIM_REGISTRY = (
         releases=(("callm", "charge"),),
         funcs=("acquire",),
     ),
+    # checkpoint write plane (ISSUE 13): a submit claims overlay/dirty
+    # state for its queued op and hands it to the drain via the queue;
+    # the drain consumer must release in a finally (a leaked claim pins
+    # the pending-create overlay and the dependent-read barrier set
+    # forever — every later read of that inode drains pointlessly, and a
+    # pending dentry shadows the committed one)
+    ClaimPair(
+        file="meta/wbatch.py",
+        name="wbatch overlay/dirty claim (submit -> drain release)",
+        acquire=("scall", "_overlay_acquire"),
+        releases=(("scall", "_overlay_release"),),
+        handoffs=(("mcall", "_queue", "append"),),
+        consumers=(("_drain_locked", (("scall", "_overlay_release"),)),),
+    ),
 )
 
 

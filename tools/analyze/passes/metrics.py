@@ -99,6 +99,17 @@ META_THROTTLE_EXPECTED = {
     "juicefs_meta_throttle_waits",
     "juicefs_meta_throttle_wait_seconds",
 }
+META_WBATCH_PREFIX = "juicefs_meta_wbatch_"
+META_WBATCH_EXPECTED = {
+    # checkpoint write plane (ISSUE 13, meta/wbatch.py): the
+    # batched/drained ratio is the group-commit amortization the
+    # BENCH_r11 acceptance counter-asserts
+    "juicefs_meta_wbatch_batched",
+    "juicefs_meta_wbatch_drained",
+    "juicefs_meta_wbatch_barrier_flushes",
+    "juicefs_meta_wbatch_overlay_hits",
+    "juicefs_meta_wbatch_passthrough",
+}
 
 
 def populate_registry() -> None:
@@ -115,6 +126,7 @@ def populate_registry() -> None:
     import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
     import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
     import juicefs_tpu.meta.cache           # noqa: F401  lease cache + throttle
+    import juicefs_tpu.meta.wbatch          # noqa: F401  write-batch plane
     import juicefs_tpu.metric.trace         # noqa: F401  stage rollup histogram
     import juicefs_tpu.object.metered       # noqa: F401  per-backend op meters
     import juicefs_tpu.object.resilient     # noqa: F401  retry/hedge/breaker
@@ -182,6 +194,8 @@ def run(files: list[SourceFile]) -> list[Finding]:
         + lint_pinned(META_CACHE_PREFIX, META_CACHE_EXPECTED, "meta-cache")
         + lint_pinned(META_THROTTLE_PREFIX, META_THROTTLE_EXPECTED,
                       "meta-throttle")
+        + lint_pinned(META_WBATCH_PREFIX, META_WBATCH_EXPECTED,
+                      "meta-wbatch")
         + lint_pinned(PREFETCH_PREFIX, PREFETCH_EXPECTED, "prefetch")
         + lint_pinned(READAHEAD_PREFIX, READAHEAD_EXPECTED, "readahead")
     )

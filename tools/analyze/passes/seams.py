@@ -19,6 +19,13 @@ shim over these.
   ``Prefetcher`` at PREFETCH class — readahead planning is SUBMITTED,
   never invoked on the read thread, and readahead/warm-hint paths never
   load blocks or hit the object store at foreground class.
+* ``wbatch-seam`` (ISSUE 13): vfs write-path mutations route through the
+  write batcher's seam — no bare ``do_mknod``/``do_write_chunk``/
+  ``do_setattr`` from ``vfs/``, the BaseMeta mutation ops must consult
+  ``wbatch``, and the drain must reach the engine ``group_txn`` (a
+  refactor that quietly drops any of these reverts every mutation to
+  one transaction per op, which no functional test catches — results
+  stay identical, only the round trips regress).
 """
 
 from __future__ import annotations
@@ -396,18 +403,100 @@ def run_prefetch_seam(files: list[SourceFile]) -> list[Finding]:
     return findings
 
 
+# write-path engine ops that must never be called bare from vfs/ — the
+# BaseMeta public ops front them with the write batcher (ISSUE 13)
+_WBATCH_BANNED = ("do_mknod", "do_write_chunk", "do_setattr")
+# BaseMeta ops that must consult the batcher seam
+_WBATCH_FRONTED = ("mknod", "write_chunk")
+
+
+def run_wbatch_seam(files: list[SourceFile]) -> list[Finding]:
+    """VFS write mutations must route through the write batcher seam
+    (ISSUE 13): a bare ``do_mknod``/``do_write_chunk``/``do_setattr``
+    from vfs/ bypasses the overlay AND the group commit, silently
+    reverting the checkpoint write path to one engine transaction per
+    mutation; the batcher itself must stay wired (BaseMeta's mutation
+    ops consult ``wbatch``, the drain reaches ``group_txn``)."""
+    findings: list[Finding] = []
+    base_sf = wb_sf = None
+    saw_pkg = False
+    for sf in files:
+        saw_pkg = saw_pkg or sf.rel.startswith("juicefs_tpu/")
+        rel = _pkg_rel(sf)
+        if rel == "meta/base.py":
+            base_sf = sf
+        elif rel == "meta/wbatch.py":
+            wb_sf = sf
+        if not rel.startswith("vfs/") or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _WBATCH_BANNED):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "wbatch-seam",
+                    f"bare {node.func.attr} from vfs/ bypasses the write "
+                    "batcher (overlay + group commit) — call the BaseMeta "
+                    "public op",
+                ))
+    if base_sf is not None and base_sf.tree is not None:
+        for fn_name in _WBATCH_FRONTED:
+            fn = None
+            for node in ast.walk(base_sf.tree):
+                if isinstance(node, ast.ClassDef) and node.name == "BaseMeta":
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef) \
+                                and item.name == fn_name:
+                            fn = item
+            if fn is None or not any(
+                isinstance(n, ast.Attribute) and n.attr == "wbatch"
+                for n in ast.walk(fn)
+            ):
+                findings.append(Finding(
+                    base_sf.rel, fn.lineno if fn else 0, "wbatch-seam",
+                    f"BaseMeta.{fn_name} never consults the write batcher "
+                    "— the checkpoint write plane is disconnected",
+                ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/meta/base.py", 0, "wbatch-seam",
+            "meta/base.py not found or unparseable",
+        ))
+    if wb_sf is not None and wb_sf.tree is not None:
+        # the drain must commit through the engine's group transaction —
+        # without it every "batched" op silently runs per-op
+        if not any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "group_txn"
+            for n in ast.walk(wb_sf.tree)
+        ):
+            findings.append(Finding(
+                wb_sf.rel, 0, "wbatch-seam",
+                "meta/wbatch.py never calls group_txn — the group-commit "
+                "seam is gone (every drain would run one txn per op)",
+            ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/meta/wbatch.py", 0, "wbatch-seam",
+            "meta/wbatch.py not found or unparseable",
+        ))
+    return findings
+
+
 def run(files: list[SourceFile]) -> list[Finding]:
     return (run_qos_seam(files) + run_resilience_seam(files)
             + run_ingest_seam(files) + run_compress_seam(files)
-            + run_meta_cache_seam(files) + run_prefetch_seam(files))
+            + run_meta_cache_seam(files) + run_prefetch_seam(files)
+            + run_wbatch_seam(files))
 
 
 PASS = Pass(
     name="seams",
     rules=("qos-seam", "resilience-seam", "ingest-seam", "compress-seam",
-           "meta-cache-seam", "prefetch-seam"),
+           "meta-cache-seam", "prefetch-seam", "wbatch-seam"),
     run=run,
     doc="architecture seams: scheduler-only pools, resilience-wrapped "
         "stores, ingest-guarded uploads, plane-routed compression, "
-        "cache-routed vfs attr reads, prefetch-routed speculative reads",
+        "cache-routed vfs attr reads, prefetch-routed speculative reads, "
+        "batcher-routed vfs write mutations",
 )

@@ -28,6 +28,8 @@ INGEST_PREFIX = _metrics.INGEST_PREFIX
 INGEST_EXPECTED = _metrics.INGEST_EXPECTED
 QOS_PREFIX = _metrics.QOS_PREFIX
 QOS_EXPECTED = _metrics.QOS_EXPECTED
+META_WBATCH_PREFIX = _metrics.META_WBATCH_PREFIX
+META_WBATCH_EXPECTED = _metrics.META_WBATCH_EXPECTED
 COMPRESS_PREFIX = _metrics.COMPRESS_PREFIX
 COMPRESS_EXPECTED = _metrics.COMPRESS_EXPECTED
 
@@ -55,6 +57,11 @@ def lint_ingest(registry=None) -> list[str]:
 
 def lint_qos(registry=None) -> list[str]:
     return _metrics.lint_pinned(QOS_PREFIX, QOS_EXPECTED, "qos", registry)
+
+
+def lint_wbatch(registry=None) -> list[str]:
+    return _metrics.lint_pinned(META_WBATCH_PREFIX, META_WBATCH_EXPECTED,
+                                "meta-wbatch", registry)
 
 
 def lint_compress(registry=None) -> list[str]:
@@ -92,7 +99,8 @@ def main() -> int:
     problems = (lint() + lint_cache_group() + lint_ingest()
                 + lint_ingest_seam() + lint_resilience()
                 + lint_qos() + lint_qos_seam()
-                + lint_compress() + lint_compress_seam())
+                + lint_compress() + lint_compress_seam()
+                + lint_wbatch())
     if problems:
         for p in problems:
             print(f"lint_metrics: {p}", file=sys.stderr)

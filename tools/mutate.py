@@ -113,6 +113,10 @@ TEST_MAP = {
                                   "tests/test_meta_dist.py"],
     "juicefs_tpu/meta/redis_server": ["tests/test_meta_cache.py",
                                       "tests/test_meta_dist.py"],
+    # ISSUE 13: checkpoint write plane — group-commit batching, overlay
+    # visibility, barrier/sticky-error contract, per-op replay, overload
+    # shed, concurrent-writer coalescing are all drilled in test_wbatch
+    "juicefs_tpu/meta/wbatch": ["tests/test_wbatch.py"],
     # ISSUE 8: batched compression plane + adaptive elision bypass
     "juicefs_tpu/tpu/compress_batch": ["tests/test_compress_batch.py"],
     "juicefs_tpu/chunk/bypass": ["tests/test_ingest.py", "-k",
