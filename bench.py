@@ -1961,6 +1961,339 @@ def main_meta_scale(argv=None) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Meta-plane chaos drill (ISSUE 14): a meta-scale mixed workload riding
+# through a PHASED primary outage — warm traffic, kill the primary
+# mid create/fsync storm, heal, verify.  Reported: availability during
+# the outage (fraction of ops served), the stale-served bound, and
+# post-heal replay correctness (slice-layout crc of every acked shard).
+#
+# In-process servers on purpose: the subject is AVAILABILITY under a
+# deterministic kill/restart, not throughput — the kill must be exact
+# (RedisServer.stop() hard-closes live conns) and the heal must restart
+# on the same port with the same AOF.
+# ---------------------------------------------------------------------------
+
+
+def run_meta_chaos_bench(clients: int = 4, warm_files: int = 16,
+                         warm_s: float = 0.8, outage_s: float = 3.0,
+                         lease_ttl: float = 0.8,
+                         max_stale: float = 60.0) -> dict:
+    import tempfile
+    import threading
+    import zlib
+
+    from juicefs_tpu.meta import Format, ROOT_INODE, Slice, new_client
+    from juicefs_tpu.meta.cache import _REPLICA_READS, _STALE_SERVED
+    from juicefs_tpu.meta.context import Context
+    from juicefs_tpu.meta.redis_server import RedisServer
+    from juicefs_tpu.meta.resilient import (BreakerState,
+                                            meta_resilience_snapshot)
+
+    root = Context(uid=0, gid=0)
+    base = tempfile.mkdtemp(prefix="jfs-metachaos-")
+    aof = os.path.join(base, "primary.aof")
+    pri = RedisServer(data_path=aof)
+    pport = pri.start()
+    rep = RedisServer(replica_of=f"127.0.0.1:{pport}")
+    rport = rep.start()
+    url = f"redis://127.0.0.1:{pport}/0"
+    n_writers = max(1, clients // 2)
+    n_readers = max(1, clients - n_writers)
+
+    def layout_crc(meta, ino: int) -> int:
+        st, slices = meta.do_read_chunk(ino, 0)
+        assert st == 0, st
+        blob = b"".join(b"%d:%d:%d;" % (s.id, s.size, s.len)
+                        for s in slices if s.id)
+        return zlib.crc32(blob)
+
+    out: dict = {"clients": clients, "warm_files": warm_files,
+                 "warm_s": warm_s, "outage_s": outage_s,
+                 "lease_ttl": lease_ttl, "degraded_max_stale": max_stale}
+    ms = []
+    pri2 = None
+    try:
+        setup = new_client(url)
+        setup.init(Format(name="metachaos", trash_days=0), force=True)
+        setup.load()
+        st, dino, _ = setup.mkdir(root, 1, b"shards", 0o755)
+        assert st == 0
+        warm_names = []
+        for i in range(warm_files):
+            nm = f"warm-{i:03d}".encode()
+            st, ino, _ = setup.create(root, dino, nm, 0o644)
+            assert st == 0
+            sid = setup.new_slice()
+            setup.write_chunk(ino, 0, 0, Slice(pos=0, id=sid, size=4096,
+                                               off=0, len=4096))
+            setup.close(root, ino)
+            warm_names.append(nm)
+        st, cold_ino, _ = setup.create(root, dino, b"cold-replica", 0o640)
+        assert st == 0
+        setup.close(root, cold_ino)
+        floor0 = setup.client._epoch_floor
+        setup.client.close()
+
+        def mk_client(replica=True):
+            m = new_client(url)
+            m.load()
+            m.configure_meta_cache(attr_ttl=lease_ttl, entry_ttl=lease_ttl)
+            if replica:
+                m.client.configure_replica(f"127.0.0.1:{rport}")
+            m.configure_write_batch(flush_ms=3.0, inode_prealloc=1024)
+            # short per-op deadline: the pre-trip window (each op paying
+            # its retry budget) must be small next to the outage itself
+            m.configure_meta_retries(max_attempts=2, deadline=0.5,
+                                     degraded_max_stale=max_stale,
+                                     min_samples=4, window=10.0,
+                                     threshold=0.5, probe_interval=0.1)
+            ms.append(m)
+            return m
+
+        for i in range(clients):
+            # reader 0 runs WITHOUT the replica: its outage ladder is the
+            # stale-lease rung (the no-replica deployment), while the
+            # other readers demonstrate replica failover
+            mk_client(replica=not (n_readers >= 2 and i == 0))
+
+        # wait for the replica to catch up before the kill
+        from juicefs_tpu.meta.redis_kv import RedisKV
+
+        probe = RedisKV(f"127.0.0.1:{rport}/0")
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            raw = probe.execute(b"GET", RedisKV.EPOCH_KEY)
+            if raw and int(raw) >= floor0:
+                break
+            time.sleep(0.05)
+        probe.close()
+
+        phase = {"name": "warm"}  # warm -> outage -> done
+        stats_lock = threading.Lock()
+        stats = {p: {"reads_ok": 0, "reads_fail": 0, "writes_ok": 0,
+                     "writes_fail": 0, "fsync_ok": 0, "fsync_fail": 0}
+                 for p in ("warm", "outage")}
+        shards = []  # (name, ino, expected_crc_seed, status)
+        shards_lock = threading.Lock()
+        stop = threading.Event()
+
+        fail_samples: list = []
+
+        def note(kind, ok, why=None):
+            p = phase["name"]
+            if p == "done":
+                return
+            with stats_lock:
+                stats[p][f"{kind}_{'ok' if ok else 'fail'}"] += 1
+                if not ok and why is not None and len(fail_samples) < 8:
+                    fail_samples.append(f"{p}/{kind}: {why}")
+
+        def reader(idx, m):
+            rng = np.random.default_rng(idx)
+            while not stop.is_set():
+                nm = warm_names[int(rng.integers(len(warm_names)))]
+                try:
+                    st, ino, _ = m.lookup(root, dino, nm)
+                    ok = st == 0
+                    if ok:
+                        ok = m.getattr(root, ino)[0] == 0
+                except OSError:
+                    ok = False
+                note("reads", ok)
+                time.sleep(0.01)
+
+        def writer(idx, m):
+            i = 0
+            while not stop.is_set():
+                nm = f"ckpt-{idx}-{i:04d}".encode()
+                i += 1
+                try:
+                    st, ino, _ = m.create(root, dino, nm, 0o644)
+                    sid = 0
+                    if st == 0:
+                        sid = m.new_slice()
+                        st = m.write_chunk(
+                            ino, 0, 0, Slice(pos=0, id=sid, size=4096,
+                                             off=0, len=4096))
+                    note("writes", st == 0, f"errno {st}")
+                    if st == 0:
+                        fst = m.sync_meta(ino)
+                        note("fsync", fst == 0)
+                        want = zlib.crc32(b"%d:%d:%d;" % (sid, 4096, 4096))
+                        with shards_lock:
+                            shards.append(
+                                (nm, ino, want, "durable" if fst == 0
+                                 else "failed"))
+                        m.close(root, ino)
+                except OSError as e:
+                    note("writes", False, repr(e))
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=reader, args=(i, ms[i]),
+                                    daemon=True)
+                   for i in range(n_readers)]
+        threads += [threading.Thread(target=writer,
+                                     args=(i, ms[n_readers + i]),
+                                     daemon=True)
+                    for i in range(n_writers)]
+        for t in threads:
+            t.start()
+        time.sleep(warm_s)
+
+        # ---- BLACKOUT: kill the primary mid create/fsync storm ----
+        stale0 = _STALE_SERVED.value
+        rr0 = _REPLICA_READS.value
+        t_kill = time.perf_counter()
+        pri.stop()  # hard-closes live conns; the phase flips only once
+        phase["name"] = "outage"  # the kill is COMPLETE
+        time.sleep(outage_s)
+        tripped = sum(1 for m in ms if m.resilience.degraded)
+        # replica failover spot-check: a cold guarded read mid-outage,
+        # through a replica-configured reader
+        cold_ok = False
+        try:
+            st, attr = ms[n_readers - 1].do_getattr(cold_ino)
+            cold_ok = st == 0 and (attr.mode & 0o777) == 0o640
+        except OSError:
+            pass
+        phase["name"] = "done"
+        stop.set()
+        for t in threads:
+            t.join(10)
+        # the replay tail: acked-but-never-barriered mutations that must
+        # commit byte-identically on heal.  Enqueued AFTER the storm
+        # threads stop — a concurrent writer's fsync barrier would
+        # otherwise (correctly) burn these into sticky EIOs before heal
+        replay = []
+        for k, m in enumerate(ms[n_readers:]):
+            nm = f"replay-{k}".encode()
+            try:
+                st, ino, _ = m.create(root, dino, nm, 0o644)
+                if st == 0:
+                    sid = m.new_slice()
+                    if m.write_chunk(ino, 0, 0,
+                                     Slice(pos=0, id=sid, size=4096,
+                                           off=0, len=4096)) == 0:
+                        replay.append(
+                            (nm, ino,
+                             zlib.crc32(b"%d:%d:%d;" % (sid, 4096, 4096))))
+            except OSError:
+                pass
+        outage_wall = time.perf_counter() - t_kill
+        stale_served = _STALE_SERVED.value - stale0
+        replica_reads = _REPLICA_READS.value - rr0
+
+        # ---- HEAL: same port, same AOF ----
+        pri2 = RedisServer(port=pport, data_path=aof)
+        pri2.start()
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            if all(m.resilience.breaker.state == BreakerState.CLOSED
+                   and not m.wbatch.has_pending() for m in ms):
+                break
+            time.sleep(0.05)
+        healed = all(m.resilience.breaker.state == BreakerState.CLOSED
+                     for m in ms)
+
+        # ---- verification via a FRESH client (engine truth) ----
+        check = new_client(url)
+        check.load()
+        durable = [s for s in shards if s[3] == "durable"]
+        failed = [s for s in shards if s[3] == "failed"]
+        durable_ok = replay_ok = True
+        for nm, ino, want, _st in durable:
+            st, got, _ = check.do_lookup(dino, nm)
+            if st != 0 or got != ino or layout_crc(check, got) != want:
+                durable_ok = False
+        replayed = 0
+        for nm, ino, want in replay:
+            st, got, _ = check.do_lookup(dino, nm)
+            if st == 0 and got == ino and layout_crc(check, got) == want:
+                replayed += 1
+            else:
+                replay_ok = False
+        check.client.close()
+
+        o = stats["outage"]
+        r_att = o["reads_ok"] + o["reads_fail"]
+        w_att = o["writes_ok"] + o["writes_fail"]
+        out.update({
+            "outage_wall_s": round(outage_wall, 2),
+            "breakers_tripped": tripped,
+            "healed": healed,
+            "warm_phase": stats["warm"],
+            "outage_phase": o,
+            "read_availability": round(o["reads_ok"] / r_att, 4)
+            if r_att else None,
+            "write_ack_availability": round(o["writes_ok"] / w_att, 4)
+            if w_att else None,
+            "fsync_loud_failures": o["fsync_fail"],
+            # DERIVED, not asserted: an acked fsync whose shard is not
+            # intact post-heal IS a silent loss
+            "silent_fsync_loss": not durable_ok,
+            "stale_served": stale_served,
+            "stale_bound_s": max_stale,
+            "replica_reads_during_outage": replica_reads,
+            "cold_read_served_by_replica": cold_ok,
+            "durable_shards": len(durable),
+            "durable_intact": durable_ok,
+            "barrier_failed_shards": len(failed),
+            "replay_tail": len(replay),
+            "replayed_clean": replayed,
+            "replay_crc_ok": replay_ok,
+            "failure_samples": fail_samples,
+            "resilience": meta_resilience_snapshot(),
+        })
+        return out
+    finally:
+        for m in ms:
+            m.resilience.close()
+            m.wbatch.close()
+            try:
+                m.client.close()
+            except Exception:
+                pass
+        if pri2 is not None:
+            pri2.stop()
+        rep.stop()
+        try:
+            pri.stop()
+        except Exception:
+            pass
+
+
+def main_meta_chaos(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--meta-chaos", action="store_true")
+    ap.add_argument("--chaos-clients", type=int, default=4)
+    ap.add_argument("--chaos-warm-files", type=int, default=16)
+    ap.add_argument("--chaos-outage-s", type=float, default=3.0)
+    ap.add_argument("--chaos-lease-ttl", type=float, default=0.8)
+    ap.add_argument("--chaos-max-stale", type=float, default=60.0)
+    args, _ = ap.parse_known_args(argv)
+    res = run_meta_chaos_bench(
+        clients=args.chaos_clients, warm_files=args.chaos_warm_files,
+        outage_s=args.chaos_outage_s, lease_ttl=args.chaos_lease_ttl,
+        max_stale=args.chaos_max_stale)
+    print(json.dumps({
+        "metric": "meta_chaos_availability",
+        "value": res.get("read_availability"),
+        "unit": "fraction of reads served during a primary blackout "
+                "(lease/stale + replica failover; acceptance: breakers "
+                "trip, zero silent fsync loss, heal replays crc-clean)",
+        "acceptance": {
+            "breakers_tripped": res.get("breakers_tripped"),
+            "healed": res.get("healed"),
+            "durable_intact": res.get("durable_intact"),
+            "replay_crc_ok": res.get("replay_crc_ok"),
+            "fsync_loud_failures": res.get("fsync_loud_failures"),
+        },
+        "meta_chaos": res,
+    }))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # QoS mixed-workload benchmark (ISSUE 6): a FOREGROUND read stream with and
 # without a saturating BACKGROUND scan sharing the unified scheduler, plus
 # token-bucket accuracy against a configured --download-limit.
@@ -2607,6 +2940,8 @@ if __name__ == "__main__":
         sys.exit(main_qos())
     if "--meta-scale" in sys.argv:
         sys.exit(main_meta_scale())
+    if "--meta-chaos" in sys.argv:
+        sys.exit(main_meta_chaos())
     if "--dataloader" in sys.argv:
         sys.exit(main_dataloader())
     sys.exit(main())

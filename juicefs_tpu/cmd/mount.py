@@ -127,6 +127,26 @@ def add_parser(sub):
                    help="inode ids preallocated per client allocation txn "
                         "while write batching is on (create storms stop "
                         "round-tripping for ids)")
+    p.add_argument("--meta-retries", type=int, default=0,
+                   help="meta-plane fault contract (ISSUE 14): max "
+                        "attempts per engine op. Transient connection "
+                        "resets/timeouts and BUSY responses retry with "
+                        "jittered deadline-aware backoff; POSIX errnos "
+                        "pass through untouched; a failing engine trips "
+                        "a circuit breaker with probe-driven recovery "
+                        "(heal re-primes the replica epoch floor, "
+                        "revives the session, replays the write batch). "
+                        "0 (default) = off, byte-identical engine calls")
+    p.add_argument("--meta-deadline", type=float, default=15.0,
+                   help="wall-clock budget per meta engine op including "
+                        "retries (with --meta-retries)")
+    p.add_argument("--meta-degraded-max-stale", type=float, default=0,
+                   help="while the meta breaker is OPEN, serve EXPIRED "
+                        "lease entries up to this many seconds past "
+                        "their lease (marked stale-served); 0 = never "
+                        "serve stale, degraded reads fail fast EIO. "
+                        "Requires --meta-retries > 0 (the breaker lives "
+                        "in the fault contract)")
     p.add_argument("--meta-op-limit", type=float, default=0,
                    help="per-tenant meta ops/s (0 = unlimited): token-"
                         "bucket throttling at the meta boundary — graceful "
@@ -206,6 +226,17 @@ def serve(args) -> int:
     )
     if getattr(args, "meta_op_limit", 0):
         m.configure_op_limit(args.meta_op_limit)
+    if getattr(args, "meta_retries", 0):
+        # meta fault contract (ISSUE 14): configured AFTER the lease
+        # cache so degraded mode sees the real LeaseCache instance
+        m.configure_meta_retries(
+            max_attempts=args.meta_retries,
+            deadline=getattr(args, "meta_deadline", 15.0),
+            degraded_max_stale=getattr(args, "meta_degraded_max_stale", 0.0))
+    elif getattr(args, "meta_degraded_max_stale", 0):
+        logger.warning("--meta-degraded-max-stale ignored: the degraded "
+                       "ladder lives in the fault contract, which needs "
+                       "--meta-retries > 0")
     if getattr(args, "write_batch", False):
         # checkpoint write plane (ISSUE 13): group-commit write batching;
         # engines without nesting transactions force it back off inside

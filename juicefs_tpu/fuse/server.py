@@ -652,8 +652,15 @@ class Server:
             self.vfs.meta, "flock"
         ):
             # FLOCK_LOCKS negotiated: the kernel delegates the implicit
-            # flock release on final close to us
-            self.vfs.meta.flock(ctx, hdr[1], lock_owner, "U")
+            # flock release on final close to us.  Best-effort under a
+            # meta outage (ISSUE 14): the kernel never resends RELEASE,
+            # so raising here would leak the handle forever while the
+            # lock dies with the session on the dark engine anyway.
+            try:
+                self.vfs.meta.flock(ctx, hdr[1], lock_owner, "U")
+            except OSError as e:
+                logger.warning("flock unlock-on-release skipped "
+                               "(meta down): %s", e)
         return self.vfs.release(ctx, hdr[1], fh)
 
     def _flush(self, ctx, hdr, body):

@@ -20,6 +20,7 @@ from . import interface
 from .cache import LeaseCache, MetaOpLimiter
 from .context import Context
 from .openfile import OpenFiles
+from .resilient import MetaResilience, MetaUnavailableError
 from .wbatch import WriteBatcher
 from .types import (
     Attr,
@@ -85,6 +86,13 @@ class BaseMeta(interface.Meta):
         # check and the path stays byte-identical to an unbatched build
         # until configure_write_batch (mount --write-batch).
         self.wbatch = WriteBatcher(self)
+        # meta-plane fault contract (meta/resilient.py, ISSUE 14):
+        # classified retries + engine breaker + degraded mode over the
+        # do_* seam.  INERT by default (nothing wrapped, zero overhead)
+        # until configure_meta_retries (mount --meta-retries).
+        self.resilience = MetaResilience(self)
+        self._beat_failures = 0  # session-refresher failure streak
+        self._statfs_last = None  # degraded statfs fallback (ISSUE 14)
         self.msg_callbacks: dict[int, Callable] = {}
         self._lock = threading.Lock()
         # batched id allocation (reference base.go:946 freeID batching)
@@ -205,6 +213,117 @@ class BaseMeta(interface.Meta):
                 "stays in TTL-0 passthrough", self.name())
             attr_ttl = entry_ttl = 0.0
         self.lease = LeaseCache(attr_ttl, entry_ttl, neg_ttl, maxsize)
+        # the fault contract may already be armed (re-configure path):
+        # the fresh lease must keep its stale-candidate retention
+        self.lease.keep_stale = (self.resilience.enabled
+                                 and self.resilience.max_stale > 0)
+
+    # -- meta-plane fault contract (ISSUE 14) ------------------------------
+    def configure_meta_retries(self, max_attempts: int = 5,
+                               deadline: float = 15.0,
+                               degraded_max_stale: float = 0.0,
+                               attempt_timeout: Optional[float] = None,
+                               **breaker_kw) -> None:
+        """Arm the meta fault contract (mount ``--meta-retries`` /
+        ``--meta-degraded-max-stale``): classified deadline-aware retries
+        over the engine ``do_*`` seam, a per-engine circuit breaker with
+        probe recovery, stale-lease degraded reads while open, and the
+        heal chain (replica floor re-prime, session revive, wbatch
+        replay).  ``max_attempts`` <= 0 keeps the contract INERT — the
+        engine methods stay untouched, byte-identical to today."""
+        if max_attempts <= 0:
+            return
+        self.resilience.configure(
+            max_attempts=max_attempts, deadline=deadline,
+            degraded_max_stale=degraded_max_stale,
+            attempt_timeout=attempt_timeout, **breaker_kw)
+        # expired leases are worth keeping only now that they can be
+        # stale-served (cache.py drops them eagerly otherwise)
+        self.lease.keep_stale = degraded_max_stale > 0
+
+    def replica_available(self) -> bool:
+        """True when the engine can serve guarded read transactions from
+        a read replica (the breaker lets those pass while open)."""
+        return False
+
+    def engine_heal(self) -> None:
+        """Engine hook fired on breaker heal; engines re-prime replica
+        state here (redis re-reads the primary epoch floor)."""
+
+    def _on_breaker_open(self) -> None:
+        """Engine-connection breaker tripped: tell the engine so guarded
+        reads stop dialing the dead primary (replica failover)."""
+        client = getattr(self, "client", None)
+        if client is not None and hasattr(client, "primary_down"):
+            client.primary_down = True
+        logger.warning("meta plane degraded: engine breaker open "
+                       "(stale-lease reads%s, writes %s)",
+                       " + replica failover" if self.replica_available()
+                       else "",
+                       "absorb into the write batch" if self.wbatch.enabled
+                       else "fail fast EIO")
+
+    def _on_meta_heal(self) -> None:
+        """Breaker reset: the heal chain.  Order matters — the replica
+        floor re-primes FIRST (a re-SYNCing replica must demote to the
+        healed primary instead of serving pre-outage state as fresh),
+        then the session revives (so the replayed wbatch groups commit
+        under a live session), then the queued groups replay."""
+        client = getattr(self, "client", None)
+        if client is not None and hasattr(client, "primary_down"):
+            client.primary_down = False
+        try:
+            self.engine_heal()
+        except Exception as e:
+            logger.warning("meta heal: engine hook failed: %s", e)
+        self._heal_session()
+        try:
+            self.wbatch.replay_after_heal()
+        except Exception as e:
+            logger.warning("meta heal: wbatch replay failed: %s", e)
+
+    def do_session_exists(self, sid: int) -> bool:
+        """Engines report whether the session record survived (a primary
+        blackout outlives the stale-session GC age for long outages)."""
+        return True
+
+    def do_revive_session(self, info: Session) -> None:
+        """Re-register a reaped session under its ORIGINAL sid (sids are
+        monotonic counter grants, never reused, so reviving cannot
+        collide with a session another client registered meanwhile).
+        The kv engines' update/refresh writes re-create both records;
+        sql overrides with an INSERT."""
+        self.do_update_session(info.sid, info)
+        self.do_refresh_session(info.sid)
+
+    def _heal_session(self) -> None:
+        """After an outage, make sure this client's session record still
+        exists — a blackout longer than the stale-session age lets a
+        peer's GC reap it, and locks/sustained-inodes/cache-group
+        discovery all key off it.  The inode prealloc ranges need no
+        repair: they are monotonic counter grants a second client can
+        never be handed again."""
+        if not self.sid:
+            return
+        try:
+            if self.do_session_exists(self.sid):
+                return
+            info = new_session_info(**self.session_extras)
+            info.sid = self.sid
+            self.do_revive_session(info)
+            self.do_watch_unlocks()
+            logger.warning("meta session %d re-registered after outage "
+                           "(record was reaped)", self.sid)
+        except Exception as e:
+            logger.warning("meta session revive failed: %s", e)
+
+    def _stale_attr(self, ino: int):
+        """Degraded-mode attr: an EXPIRED lease within the configured
+        staleness ceiling (None outside it / when not degraded)."""
+        res = self.resilience
+        if not res.degraded or res.max_stale <= 0:
+            return None
+        return self.lease.get_attr_stale(ino, res.max_stale)
 
     def configure_op_limit(self, ops_per_sec: float) -> None:
         """Per-tenant meta-op throttling (--meta-op-limit).  0 disables."""
@@ -287,7 +406,17 @@ class BaseMeta(interface.Meta):
                 attr = self.lease.get_attr(ino)
             if attr is not None:
                 return 0, attr
-        st, attr = self.do_getattr(ino)
+        # degraded mode (ISSUE 14): breaker open — an expired lease
+        # within the staleness ceiling serves (stale-served, counted)
+        # before any engine dial; past the ceiling the engine call fails
+        # fast EIO rather than hanging the FUSE request path
+        attr = self._stale_attr(ino)
+        if attr is not None:
+            return 0, attr
+        try:
+            st, attr = self.do_getattr(ino)
+        except MetaUnavailableError as e:
+            return e.errno, Attr()
         if st == 0:
             self.lease.put_attr(ino, attr)
         return st, attr
@@ -390,6 +519,7 @@ class BaseMeta(interface.Meta):
 
     def close_session(self) -> None:
         self.wbatch.close()  # final drain: acked mutations never drop
+        self.resilience.close()  # stop the breaker probe thread
         self._stop.set()
         hb = self._heartbeat
         if hb is not None and hb.is_alive() \
@@ -404,9 +534,16 @@ class BaseMeta(interface.Meta):
         while not self._stop.wait(interval):
             try:
                 self.do_refresh_session(self.sid)
+                if self._beat_failures:
+                    # first beat after an outage: the session record may
+                    # have been reaped while we were dark — revive it
+                    # (same sid) before peers treat us as gone (ISSUE 14)
+                    self._beat_failures = 0
+                    self._heal_session()
                 self._check_reload()
                 self._exchange_invalidations()
             except Exception as e:  # pragma: no cover - background resilience
+                self._beat_failures += 1
                 logger.warning("session refresh failed: %s", e)
 
     # -- push invalidation --------------------------------------------------
@@ -671,8 +808,23 @@ class BaseMeta(interface.Meta):
             # dangling lease (inode vanished under the dentry): drop and
             # revalidate through the engine
             self.lease.invalidate_entry(parent, name)
-        st, ino, attr = self.do_lookup(
-            parent, name, hint_ino=self.lease.entry_hint(parent, name))
+        if self.resilience.degraded:
+            # degraded lookup (ISSUE 14): an expired positive dentry
+            # within the stale ceiling serves (negatives never stale-
+            # serve — a stale ENOENT could hide a real file for the
+            # whole outage); a miss falls through to the engine, which
+            # either fails over to the replica or fails fast EIO
+            sino = self.lease.get_entry_stale(parent, name,
+                                              self.resilience.max_stale)
+            if sino:
+                st, attr = self._attr_cached(sino)
+                if st == 0:
+                    return 0, sino, attr
+        try:
+            st, ino, attr = self.do_lookup(
+                parent, name, hint_ino=self.lease.entry_hint(parent, name))
+        except MetaUnavailableError as e:
+            return e.errno, 0, Attr()
         if st:
             if st == errno.ENOENT:
                 self.lease.put_negative(parent, name)
@@ -710,7 +862,13 @@ class BaseMeta(interface.Meta):
         cached = self.lease.get_attr(ino)
         if cached is not None:
             return 0, cached
-        st, attr = self.do_getattr(ino)
+        cached = self._stale_attr(ino)  # degraded: bounded stale serve
+        if cached is not None:
+            return 0, cached
+        try:
+            st, attr = self.do_getattr(ino)
+        except MetaUnavailableError as e:
+            return e.errno, Attr()
         if st == 0:
             # of.update only on a REAL fetch: refreshing the open-file
             # TTL from a lease hit would extend its staleness bound
@@ -888,10 +1046,17 @@ class BaseMeta(interface.Meta):
             # drained group — every pending op (the shard's create and
             # slice commits) lands in the SAME engine transaction ahead
             # of it, and concurrent renames coalesce under one leader
-            st, ino, attr = self.wbatch.run_sync(
+            out = self.wbatch.run_sync(
                 lambda: self.do_rename(ctx, psrc, nsrc, pdst, ndst, flags),
                 parent=psrc, kind="rename",
                 args=(psrc, bytes(nsrc), pdst, bytes(ndst)))
+            if isinstance(out, int):
+                # the drain settled this sync op with a bare errno (the
+                # engine raised — e.g. breaker-open EIO during an
+                # outage): normalize to the rename result shape
+                st, ino, attr = out, 0, Attr()
+            else:
+                st, ino, attr = out
         else:
             st, ino, attr = self.do_rename(ctx, psrc, nsrc, pdst, ndst, flags)
         if st == 0:
@@ -932,11 +1097,16 @@ class BaseMeta(interface.Meta):
         if st:
             return st, []
         if want_attr and self.lease.enabled:
-            # readdirplus primes the attr leases: the stat-after-list
-            # pattern (every dataloader epoch) then serves from the cache
+            # readdirplus primes attr AND dentry leases: the
+            # stat-after-list pattern (every dataloader epoch) then
+            # serves from the cache — and during a meta outage the
+            # dentry lease is what lets a listed name still RESOLVE
+            # (ISSUE 14: the attr alone cannot be reached without it;
+            # found live in the blackout mount drive)
             for e in entries:
                 if e.attr.full:
                     self.lease.put_attr(e.inode, e.attr)
+                    self.lease.put_entry(ino, e.name, e.inode)
         st2, attr = self._attr_cached(ino)
         if st2 == 0:
             entries.insert(0, Entry(inode=ino, name=b".", attr=attr))
@@ -957,13 +1127,28 @@ class BaseMeta(interface.Meta):
         # OVERLAY is exempt: it cannot exist remotely before its group
         # commit, so this client's ack attr is the whole truth.
         attr = self.wbatch.attr_overlay(ino) if self.wbatch.enabled else None
+        stale_served = False
         if attr is None:
             if self.wbatch.enabled:
                 self.wbatch.barrier_if(ino)
-            st, attr = self.do_getattr(ino)
-            if st:
-                return st, Attr()
-            self.lease.put_attr(ino, attr)
+            try:
+                st, attr = self.do_getattr(ino)
+            except MetaUnavailableError as e:
+                # degraded open (ISSUE 14): the revalidation fetch is
+                # impossible while the breaker is open — a bounded stale
+                # lease keeps the dataloader's open() path serving (the
+                # staleness ceiling the operator chose), else EIO.  The
+                # stale attr must NOT re-prime the lease OR the openfile
+                # cache: either would re-serve it as fresh, uncounted
+                # and past the configured bound.
+                attr = self._stale_attr(ino)
+                if attr is None:
+                    return e.errno, Attr()
+                stale_served = True
+            else:
+                if st:
+                    return st, Attr()
+                self.lease.put_attr(ino, attr)
         if attr.typ != TYPE_FILE:
             return errno.EPERM, Attr()
         if ctx.check_permission:
@@ -976,7 +1161,7 @@ class BaseMeta(interface.Meta):
             st = self.access(ctx, ino, mask, attr)
             if st:
                 return st, Attr()
-        self.of.open(ino, attr)
+        self.of.open(ino, attr, trusted=not stale_served)
         return 0, attr
 
     def close(self, ctx, ino) -> int:
@@ -1200,8 +1385,22 @@ class BaseMeta(interface.Meta):
     # -- admin / tools -----------------------------------------------------
     def statfs(self, ctx) -> tuple[int, int, int, int]:
         """(total_bytes, avail_bytes, used_inodes, avail_inodes)
-        (reference base.go StatFS)."""
-        return self.do_statfs()
+        (reference base.go StatFS).
+
+        Degraded fallback (ISSUE 14): statfs is the liveness probe of the
+        world around the mount — `df`, shell path walks, and the mount
+        WATCHDOG's statvfs loop.  During a meta outage the last-known
+        answer serves (usage counters are already approximate), or a
+        120s blackout would make the watchdog shoot a mount that is
+        successfully serving degraded reads."""
+        try:
+            out = self.do_statfs()
+        except MetaUnavailableError:
+            if self._statfs_last is not None:
+                return self._statfs_last
+            raise
+        self._statfs_last = out
+        return out
 
     def summary(self, ctx, ino: int) -> tuple[int, Summary]:
         """du aggregate over a subtree (reference base.go GetSummary)."""
@@ -1318,6 +1517,20 @@ class BaseMeta(interface.Meta):
         for ino, length in files.items():
             self.do_delete_file_data(ino, length)
         return len(files)
+
+    def compact_commit(self, ino: int, indx: int, snapshot: bytes,
+                       merged: Slice) -> int:
+        """Commit a chunk compaction (vfs/compact.py) — the one engine
+        write the background compactor issues, fronted here so the fault
+        guard and the wbatch dependent-write barrier cover it
+        (meta-resilience-seam: no bare ``do_*`` from vfs/)."""
+        if self.wbatch.enabled:
+            self.wbatch.barrier_if(ino)
+        return self.do_compact_chunk(ino, indx, snapshot, merged)
+
+    def do_compact_chunk(self, ino: int, indx: int, snapshot: bytes,
+                         merged: Slice) -> int:
+        return errno.ENOTSUP
 
     def list_slices(self) -> dict[int, list[Slice]]:
         """All live slices keyed by inode, for gc/fsck

@@ -79,6 +79,11 @@ _REPLICA_STALE = _reg.counter(
     "Replica reads refused because the replica's change-epoch lagged "
     "this client's floor (fell back to the primary)",
 )
+_STALE_SERVED = _reg.counter(
+    "juicefs_meta_stale_served",
+    "Expired lease entries served during breaker-open degraded mode "
+    "(bounded by --meta-degraded-max-stale; ISSUE 14)",
+)
 _THROTTLE_WAITS = _reg.counter(
     "juicefs_meta_throttle_waits",
     "Meta ops that waited for a per-tenant token (--meta-op-limit)",
@@ -123,6 +128,12 @@ class LeaseCache:
         self._attrs: OrderedDict = OrderedDict()     # ino -> (attr, expires)
         self._entries: OrderedDict = OrderedDict()   # (p, name) -> (ino, exp)
         self._lock = threading.Lock()
+        self.n_stale_served = 0  # degraded-mode serves (.status mirror)
+        # retain expired attrs as degraded-mode stale candidates (set by
+        # configure_meta_retries when a stale ceiling is armed).  OFF, a
+        # build that can never stale-serve drops them eagerly — retained
+        # corpses would evict LIVE leases under LRU pressure for nothing
+        self.keep_stale = False
 
     @property
     def enabled(self) -> bool:
@@ -139,9 +150,12 @@ class LeaseCache:
                 return None
             attr, expires = item
             if time.monotonic() >= expires:
-                # expired leases are dropped eagerly — unlike dentries,
-                # a stale attr carries no revalidation hint worth keeping
-                del self._attrs[ino]
+                # expired leases never serve here; with a stale ceiling
+                # armed the entry is RETAINED (LRU-bounded) as the
+                # degraded-mode candidate get_attr_stale serves while
+                # the engine breaker is open (ISSUE 14)
+                if not self.keep_stale:
+                    del self._attrs[ino]
                 _EXP_ATTR.inc()
                 _MISS_ATTR.inc()
                 return None
@@ -157,6 +171,55 @@ class LeaseCache:
             self._attrs.move_to_end(ino)
             while len(self._attrs) > self.maxsize:
                 self._attrs.popitem(last=False)
+
+    def get_attr_stale(self, ino: int, max_stale: float):
+        """Degraded-mode attr read (ISSUE 14): serve a LIVE OR EXPIRED
+        lease as long as it has not been expired for more than
+        ``max_stale`` seconds.  Only the fault contract calls this, and
+        only while the engine breaker is open — every serve is counted
+        (the blackout drill's stale-served bound assertion)."""
+        if self.attr_ttl <= 0 or max_stale <= 0:
+            return None
+        with self._lock:
+            item = self._attrs.get(ino)
+            if item is None:
+                return None
+            attr, expires = item
+            now = time.monotonic()
+            if now >= expires + max_stale:
+                del self._attrs[ino]  # past the ceiling: no longer useful
+                return None
+            self._attrs.move_to_end(ino)
+            if now >= expires:
+                self.n_stale_served += 1
+                _STALE_SERVED.inc()
+            return attr
+
+    def get_entry_stale(self, parent: int, name: bytes,
+                        max_stale: float) -> int:
+        """Degraded-mode dentry read: a POSITIVE mapping within the
+        staleness ceiling (0 otherwise).  Negative entries never
+        stale-serve — a stale ENOENT would hide a real file for the
+        whole outage, which is a far worse lie than a stale attr."""
+        if self.entry_ttl <= 0 or max_stale <= 0:
+            return 0
+        with self._lock:
+            item = self._entries.get((parent, bytes(name)))
+            if item is None:
+                return 0
+            ino, expires = item
+            now = time.monotonic()
+            if ino == self.NEGATIVE:
+                return 0
+            if now >= expires + max_stale:
+                # past the ceiling: no longer useful even as a hint for
+                # this outage (same cleanup as the attr side)
+                del self._entries[(parent, bytes(name))]
+                return 0
+            if now >= expires:
+                self.n_stale_served += 1
+                _STALE_SERVED.inc()
+            return ino
 
     def invalidate_attr(self, ino: int) -> None:
         with self._lock:
@@ -233,6 +296,7 @@ class LeaseCache:
                 "neg_ttl": self.neg_ttl,
                 "attrs": len(self._attrs),
                 "entries": len(self._entries),
+                "stale_served": self.n_stale_served,
             }
 
 

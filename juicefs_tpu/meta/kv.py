@@ -445,6 +445,23 @@ class KVMeta(BaseMeta):
         self.client.txn(lambda tx: tx.set(
             self._session_key(sid), info.to_json().encode()))
 
+    def do_session_exists(self, sid: int) -> bool:
+        return self.client.simple_txn(
+            lambda tx: tx.get(self._session_key(sid)) is not None)
+
+    # -- meta fault contract hooks (ISSUE 14) ------------------------------
+    def replica_available(self) -> bool:
+        return getattr(self.client, "replica_host", None) is not None
+
+    def engine_heal(self) -> None:
+        """Breaker heal: re-prime the replica-read epoch floor from the
+        healed primary — a replica still re-SYNCing holds pre-outage
+        state at a pre-outage epoch, and a stale floor would let it pass
+        the lag guard and serve that state as fresh."""
+        heal = getattr(self.client, "on_primary_heal", None)
+        if heal is not None:
+            heal()
+
     def do_clean_session(self, sid: int) -> None:
         """Release a session: reclaim sustained inodes, drop its locks
         (reference base.go:504 CleanStaleSessions / doCleanStaleSession)."""

@@ -45,11 +45,21 @@ class OpenFiles:
             or old.length != new.length
         )
 
-    def open(self, ino: int, attr: Optional[Attr]) -> None:
+    def open(self, ino: int, attr: Optional[Attr],
+             trusted: bool = True) -> None:
+        """``trusted=False`` registers the reference WITHOUT caching the
+        attr as servable (ISSUE 14): a degraded open may carry a
+        stale-lease attr whose staleness is ceiling-checked and counted
+        at the lease layer — caching it here would re-serve it as fresh
+        for `expire` seconds, uncounted and unbounded."""
         with self._lock:
             of = self._files.get(ino)
             if of is None:
-                self._files[ino] = _OpenFile(attr or Attr())
+                of = self._files[ino] = _OpenFile(attr or Attr())
+                if not trusted:
+                    of.last = 0.0  # registered, but attr never serves
+            elif not trusted:
+                of.refs += 1  # keep whatever trusted state exists
             else:
                 of.refs += 1
                 if attr is not None:

@@ -43,6 +43,13 @@ class MetaNetworkError(ConnectionError):
     """
 
 
+class MetaCommitUnknownError(MetaNetworkError):
+    """The connection died AFTER the commit pipeline was fully sent: the
+    transaction may or may not have been applied.  Classified AMBIGUOUS
+    by the fault contract (ISSUE 14) — never blindly retried, because a
+    rerun of a read-modify-write that DID land would double-apply."""
+
+
 class RespConnection:
     """One RESP2 connection (binary-safe, minimal)."""
 
@@ -261,6 +268,12 @@ class _ReadTxn(KVTxn):
             except MetaNetworkError:
                 cl._drop_replica_conn()
         if self._conn is None:
+            if cl.primary_down:
+                # failover mode (ISSUE 14): the breaker already knows
+                # the primary is dark — fail fast instead of paying a
+                # connect timeout per read that the replica refused
+                raise MetaNetworkError(
+                    "primary down and replica refused (lagging/dead)")
             self._conn = cl._conn()
         if first_cmd is None:
             return None
@@ -336,6 +349,11 @@ class RedisKV(TKVClient):
         self.replica_host: Optional[str] = None
         self.replica_port: int = 0
         self._epoch_floor = 0
+        # FAILOVER flag (ISSUE 14): set by the meta breaker's on_open —
+        # read transactions stop dialing the dead primary (the replica
+        # serves everything the epoch guard admits; past the guard they
+        # fail fast instead of paying a connect to a dead host)
+        self.primary_down = False
         if replica:
             self.configure_replica(replica)
         self.execute(b"PING")  # fail fast on a bad address
@@ -392,6 +410,28 @@ class RedisKV(TKVClient):
         client has observed on the primary."""
         if v and v > self._epoch_floor:
             self._epoch_floor = v
+
+    def reprime_epoch_floor(self) -> None:
+        """Re-read the primary's CURRENT epoch and raise the floor to it
+        (ISSUE 14 heal chain).  A client that rode out an outage on the
+        replica has a floor frozen at its last observed epoch; the
+        primary may have committed far past it before dying, and the
+        replica re-SYNCs asynchronously — without this re-prime the
+        stale floor would let the still-catching-up replica serve
+        pre-outage state as fresh."""
+        self.advance_epoch(
+            self._epoch_of(self.execute(b"GET", self.EPOCH_KEY)))
+
+    def on_primary_heal(self) -> None:
+        """Breaker heal hook: drop failover mode and re-prime the floor.
+        The dead thread-local sockets redial lazily on next use."""
+        self.primary_down = False
+        try:
+            self.reprime_epoch_floor()
+        except MetaNetworkError:
+            # healed-then-flapped: the next op re-trips the breaker
+            logger.warning("epoch floor re-prime failed; replica reads "
+                           "stay guarded by the old floor")
 
     @staticmethod
     def _epoch_of(raw) -> int:
@@ -567,7 +607,7 @@ class RedisKV(TKVClient):
                 # read-modify-write — so surface the error to the caller.
                 self._drop_conn()
                 if committing:
-                    raise MetaNetworkError(
+                    raise MetaCommitUnknownError(
                         "connection lost while committing; outcome unknown"
                     ) from e
                 net_failures += 1

@@ -88,6 +88,12 @@ class RedisServer:
         self._pub_thread.start()
         self._srv: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # live client connections: stop() hard-closes them so a stopped
+        # server goes DARK (ISSUE 14 blackout drills; same contract as
+        # PeerBlockServer.stop()).  Without this, established handler
+        # threads keep serving the in-memory dbs after "stop" and a
+        # simulated primary kill kills nothing.
+        self._conns: set = set()
         self.data_path = data_path
         self.fsync = fsync
         self._aof = None
@@ -159,8 +165,17 @@ class RedisServer:
         self.lock). EVERY db is FLUSHDB'd — including ones empty on the
         primary — so a re-SYNC after a replication gap cannot leave
         ghosts on the replica (a db flushed on the primary while the
-        replica was away must be flushed there too)."""
+        replica was away must be flushed there too).
+
+        The whole snapshot is framed MULTI..EXEC so the replica's pull
+        loop applies it under ONE lock hold (ISSUE 14): applied
+        command-by-command, a reader attached mid-re-SYNC could observe
+        the FLUSHDB-to-repopulated window — and because dict order puts
+        the !epoch key EARLY (it is written by the first commit), the
+        epoch lag guard would PASS while most of the namespace was still
+        missing, serving ENOENT for files that exist as if fresh."""
         buf = bytearray()
+        buf += _Conn._enc([b"MULTI"])
         for i, db in enumerate(self.dbs):
             buf += _Conn._enc([b"SELECT", str(i).encode()])
             buf += _Conn._enc([b"FLUSHDB"])
@@ -169,6 +184,7 @@ class RedisServer:
             for name, members in db.zsets.items():
                 for m in members:
                     buf += _Conn._enc([b"ZADD", name, b"0", m])
+        buf += _Conn._enc([b"EXEC"])
         return bytes(buf)
 
     # ---- replication (replica side) --------------------------------------
@@ -429,6 +445,18 @@ class RedisServer:
             self._srv.shutdown()
             self._srv.server_close()
             self._srv = None
+        with self.lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                # shutdown, not just close: the handler's makefile reader
+                # holds the fd, so close() alone would leave the TCP
+                # stream fully functional until the handler exits
+                c.sock.shutdown(socket.SHUT_RDWR)
+                c.sock.close()
+            except OSError:
+                logger.debug("stale conn close raced its own teardown")
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
@@ -543,6 +571,8 @@ class _Conn:
 
     # ---- serve loop ------------------------------------------------------
     def serve(self) -> None:
+        with self.server.lock:
+            self.server._conns.add(self)
         try:
             while True:
                 cmd = self._read_cmd()
@@ -558,6 +588,7 @@ class _Conn:
             pass
         finally:
             with self.server.lock:
+                self.server._conns.discard(self)
                 for ch in self.subscribed:
                     conns = self.server.subscribers.get(ch)
                     if conns is not None:

@@ -530,6 +530,25 @@ class SQLMeta(BaseMeta):
 
         self._txn(fn)
 
+    def do_session_exists(self, sid: int) -> bool:
+        return self._rtxn(lambda cur: cur.execute(
+            "SELECT 1 FROM session2 WHERE sid=?", (sid,)
+        ).fetchone() is not None)
+
+    def do_revive_session(self, info: Session) -> None:
+        """Re-register a reaped session under its original sid (ISSUE
+        14): the base default's UPDATE pair writes zero rows once the
+        record is gone, so sql needs a real INSERT."""
+        def fn(cur):
+            cur.execute(
+                "INSERT OR REPLACE INTO session2 (sid, info, heartbeat) "
+                "VALUES (?,?,?)",
+                (info.sid, info.to_json(), time.time()),
+            )
+            return 0
+
+        self._txn(fn)
+
     def do_clean_session(self, sid: int) -> None:
         sustained = self._rtxn(lambda cur: [
             r[0] for r in cur.execute(

@@ -59,6 +59,7 @@ from typing import Callable, Optional
 
 from ..metric import global_registry
 from ..utils import get_logger, lockwatch
+from .resilient import MetaUnavailableError
 from .types import (
     Attr,
     CHUNK_SIZE,
@@ -123,11 +124,11 @@ class _Op:
     drain closure stays safe under txn-rerun doubling."""
 
     __slots__ = ("kind", "ino", "parent", "name", "args", "run", "event",
-                 "slot", "ts")
+                 "slot", "ts", "scope")
 
     def __init__(self, kind: str, ino: int, parent: int, name: bytes,
                  run: Callable, event: Optional[threading.Event] = None,
-                 args: tuple = ()):
+                 args: tuple = (), scope=None):
         self.kind = kind
         self.ino = ino
         self.parent = parent
@@ -139,6 +140,10 @@ class _Op:
         self.event = event
         self.slot = None  # sync ops: the engine result, set by the leader
         self.ts = time.monotonic()  # enqueue time (the flusher's age gate)
+        # fences only: the inodes this barrier is FOR (None = full
+        # barrier).  A degraded drain fails only the scoped ops loudly
+        # and requeues the rest for heal replay (ISSUE 14)
+        self.scope = scope
 
 
 def _status_of(r) -> int:
@@ -185,6 +190,14 @@ class WriteBatcher:
         # parent-attr memo for the submit-side checks (cleared per drain:
         # staleness is bounded by the flush window)
         self._parent_memo: dict[int, Attr] = {}
+        # last-known parent attrs, NOT cleared at drain: the degraded-
+        # mode fallback (ISSUE 14).  Every ack's write-through correctly
+        # invalidates the parent's lease, so at outage onset the absorb
+        # path would otherwise have no parent knowledge left to check
+        # creates against — this map carries the last successful fetch
+        # across the breaker-open window (same trust level as a stale
+        # lease: bounded by the outage), and is only consulted degraded.
+        self._parent_last: dict[int, Attr] = {}
         # pending-op refcounts for the dependent-read barriers
         self._dirty: dict[int, int] = {}
         self._dirty_parents: dict[int, int] = {}
@@ -212,14 +225,27 @@ class WriteBatcher:
         a = self._ov_attrs.get(parent)
         if a is not None:
             _OV_ATTR.inc()
+            # an overlay ack is authoritative parent knowledge too —
+            # without this a dir created right before the outage would
+            # have no degraded fallback once its overlay entry drains
+            self._parent_last[parent] = a
             return a
         a = self._parent_memo.get(parent)
         if a is not None:
             return a
         st, a = self.meta._attr_cached(parent)
         if st:
+            if self._degraded():
+                # breaker open and the lease was (correctly) dropped by
+                # an earlier ack's write-through: fall back to the last
+                # attr this batcher fetched for the parent, so a create
+                # storm keeps absorbing through the outage
+                return self._parent_last.get(parent)
             return None
         self._parent_memo[parent] = a
+        self._parent_last[parent] = a
+        if len(self._parent_last) > 4096:  # id-sweep bound
+            self._parent_last.pop(next(iter(self._parent_last)))
         return a
 
     def submit_mknod(self, ctx, parent: int, name: bytes, typ: int,
@@ -238,6 +264,14 @@ class WriteBatcher:
             return None
         pattr = self._parent_attr(parent)
         if pattr is None:
+            if self._degraded() \
+                    or getattr(self.meta.resilience, "enabled", False):
+                # with the fault contract armed, a missing parent attr
+                # may mean the ENGINE IS DARK, not that the dir is gone:
+                # declining routes through passthrough, which surfaces
+                # the honest errno (ENOENT from a healthy engine, EIO
+                # from an outage) instead of guessing
+                return None
             return errno.ENOENT, 0, Attr()
         if pattr.typ != TYPE_DIRECTORY:
             return errno.ENOTDIR, 0, Attr()
@@ -368,7 +402,7 @@ class WriteBatcher:
         return bool(self._queue or self._dirty or self._dirty_parents)
 
     # -- barriers ----------------------------------------------------------
-    def barrier(self, ino: int = 0, clear: bool = False) -> int:
+    def barrier(self, ino: int = 0, clear: bool = False, scope=None) -> int:
         """Drain the batch (fsync/flush/close).  Returns the sticky error
         for ``ino`` — an acked mutation that failed at drain keeps
         surfacing here until ``clear`` (close) pops it.
@@ -386,7 +420,7 @@ class WriteBatcher:
         mutations whose group transaction is still uncommitted."""
         if self._queue or self._dirty or self._dirty_parents:
             ev = threading.Event()
-            fence = _Op("sync", 0, 0, b"", lambda: 0, event=ev)
+            fence = _Op("sync", 0, 0, b"", lambda: 0, event=ev, scope=scope)
             with self._qlock:
                 self._queue.append(fence)
             self.n_barrier_flushes += 1
@@ -400,14 +434,16 @@ class WriteBatcher:
 
     def barrier_if(self, *inos: int) -> None:
         """Dependent-read barrier: drain when any involved inode has
-        pending ops (as target or as parent of pending creates)."""
+        pending ops (as target or as parent of pending creates).  The
+        fence carries the implicated inodes as its SCOPE, so a drain
+        during a breaker-open outage fails only these inodes' ops."""
         if any(i in self._dirty or i in self._dirty_parents for i in inos):
-            self.barrier()
+            self.barrier(scope=frozenset(inos))
 
     def barrier_if_entry(self, parent: int, name: bytes) -> None:
         if (parent, bytes(name)) in self._ov_entries \
                 or parent in self._dirty or parent in self._dirty_parents:
-            self.barrier()
+            self.barrier(scope=frozenset((parent,)))
 
     def fsync_barrier(self, ino: int) -> int:
         """fsync/flush for ONE file: drain only when this inode is
@@ -450,12 +486,33 @@ class WriteBatcher:
         return op.slot
 
     # -- drain (group commit) ----------------------------------------------
+    def _degraded(self) -> bool:
+        """True while the meta engine breaker is open (ISSUE 14): the
+        timer and full-queue kicks stop draining so the queue ABSORBS
+        acked writes up to the shed bound — they replay byte-identically
+        on heal.  Barriers still drain (and fail loudly, sticky EIO):
+        an fsync must never ack durability it cannot have."""
+        res = getattr(self.meta, "resilience", None)
+        return res is not None and res.degraded
+
+    def replay_after_heal(self) -> None:
+        """Heal-chain hook: commit everything the outage queue absorbed.
+        The deferred closures are pre-bound (ino, attrs, slices), so the
+        replayed groups are byte-identical to what was acked."""
+        if self.enabled and self.has_pending():
+            n = len(self._queue)
+            self.barrier()
+            logger.warning("wbatch replayed %d absorbed mutations after "
+                           "meta heal", n)
+
     def _maybe_kick(self) -> None:
         # full batch: drain on the submitting thread — but never BLOCK a
         # producer behind a slow leader (their snapshot excludes our ops
         # anyway); while a drain is in flight the queue may grow toward
-        # the 4x shed bound, where submits degrade to passthrough
-        if len(self._queue) >= self.max_batch:
+        # the 4x shed bound, where submits degrade to passthrough.
+        # Degraded (breaker open) the kick is suppressed: draining now
+        # would only burn the queue into sticky errors — absorb instead
+        if len(self._queue) >= self.max_batch and not self._degraded():
             self._drain(blocking=False)
 
     def _drain(self, blocking: bool = True) -> None:
@@ -499,11 +556,62 @@ class WriteBatcher:
             # their near-simultaneous siblings (the other writers' fsync
             # fences and renames) join THIS snapshot too
             time.sleep(self.group_window)
+        degraded = self._degraded()
         with self._qlock:
             ops, self._queue = self._queue, []
-            self._parent_memo.clear()
+            if not degraded:
+                # the memo's staleness is normally bounded by the flush
+                # window; during an outage it is deliberately KEPT — it
+                # is the only parent knowledge the absorb path has left
+                # (each ack's write-through drops the lease), and its
+                # staleness is bounded by the outage itself
+                self._parent_memo.clear()
         if not ops:
             return 0
+        if degraded:
+            # barrier-driven drain during a breaker-open outage: the
+            # engine cannot commit, so the ops this barrier is FOR fail
+            # LOUDLY — sticky EIO per inode, sync ops settled with EIO —
+            # without burning a retry deadline per op.  Everything
+            # OUTSIDE the barrier's scope is REQUEUED (claims held):
+            # writer A's fsync must not incinerate writer B's absorbed
+            # mutations, which replay byte-identically on heal.  An
+            # unscoped fence (flush_all/unmount/rename/rmr) — or a
+            # fence-less drain (close()) — fails the whole snapshot.
+            fences = [op for op in ops if op.event is not None]
+            scope: set = set()
+            full = not fences  # close()-time: loud, never a silent drop
+            for f in fences:
+                if f.scope is None:
+                    full = True
+                else:
+                    scope |= f.scope
+            failed, keep = [], []
+            for op in ops:
+                if op.event is not None or full \
+                        or op.ino in scope or op.parent in scope:
+                    failed.append(op)
+                else:
+                    keep.append(op)
+            if keep:
+                with self._qlock:
+                    # prepend: older than anything enqueued mid-drain,
+                    # preserving per-inode FIFO order
+                    self._queue[:0] = keep
+            try:
+                for op in failed:
+                    if op.event is not None:
+                        op.slot = errno.EIO
+                    else:
+                        self._errors.setdefault(op.ino or op.parent,
+                                                errno.EIO)
+                        logger.error(
+                            "wbatch deferred %s on ino %d failed EIO: meta "
+                            "engine breaker open (barrier during outage)",
+                            op.kind, op.ino)
+            finally:
+                self._overlay_release(failed)
+            return len(failed)
         results: list = []
         meta = self.meta
 
@@ -529,12 +637,25 @@ class WriteBatcher:
                 failed = -1
             if failed:
                 del results[:]
+                unavailable = False
                 for op in ops:
                     # per-op replay: each mutation under its own engine
-                    # transaction with its own discard semantics
+                    # transaction with its own discard semantics.  Once
+                    # one replay reports the engine UNAVAILABLE (breaker
+                    # open / retries spent), the rest fail EIO without
+                    # each burning its own retry deadline (ISSUE 14)
+                    if unavailable:
+                        results.append((op, errno.EIO, errno.EIO))
+                        continue
                     try:
                         r = op.run()
                         st = _status_of(r)
+                    except MetaUnavailableError as e:
+                        unavailable = True
+                        logger.error("wbatch replay %s ino=%d: %s "
+                                     "(failing the rest of the group fast)",
+                                     op.kind, op.ino, e)
+                        st, r = errno.EIO, errno.EIO
                     except Exception as e:
                         logger.error("wbatch replay %s ino=%d: %s",
                                      op.kind, op.ino, e)
@@ -619,7 +740,8 @@ class WriteBatcher:
                 # storm the barriers drain continuously, and a flusher
                 # that grabbed leadership for every fresh arrival would
                 # shatter the very groups the barriers are building.
-                if q and time.monotonic() - q[0].ts >= self.flush_interval:
+                if q and time.monotonic() - q[0].ts >= self.flush_interval \
+                        and not self._degraded():
                     self._drain(blocking=False)
             except Exception:  # pragma: no cover - background resilience
                 logger.exception("wbatch timed flush")

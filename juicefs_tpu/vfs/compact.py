@@ -68,7 +68,7 @@ def compact_chunk(meta, store, ino: int, indx: int) -> bool:
         return False
 
     merged = Slice(pos=0, id=new_id, size=length, off=0, len=length)
-    st = meta.do_compact_chunk(ino, indx, snapshot, merged)
+    st = meta.compact_commit(ino, indx, snapshot, merged)
     if st != 0:
         # Lost the race to a concurrent compaction: drop our copy.
         logger.info("compact ino=%d indx=%d: conflict (%d), discarding", ino, indx, st)

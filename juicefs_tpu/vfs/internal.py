@@ -275,9 +275,22 @@ class InternalFiles:
             out["readahead"] = reader.stats()
         # checkpoint write plane (ISSUE 13): group-commit batching state —
         # queue depth, drains vs batched mutations, sticky deferred errors
-        wb = getattr(getattr(self.vfs, "meta", None), "wbatch", None)
+        meta = getattr(self.vfs, "meta", None)
+        wb = getattr(meta, "wbatch", None)
         if wb is not None:
             out["wbatch"] = wb.stats()
+        # meta-plane fault contract (ISSUE 14): breaker state + probe
+        # age, stale-served count, replica role — the meta twin of the
+        # object_plane snapshot above (a blackout must be OBSERVABLE
+        # here, not just inferable from EIOs)
+        res = getattr(meta, "resilience", None)
+        if res is not None:
+            mp = res.health()
+            if mp.get("enabled"):
+                mp["lease"] = meta.lease.stats()
+                mp["session"] = {"sid": meta.sid,
+                                 "beat_failures": meta._beat_failures}
+            out["meta_plane"] = mp
         # unified I/O scheduler + bandwidth budget (ISSUE 6): lane/queue
         # occupancy per class and token-bucket levels
         sched = getattr(store, "scheduler", None)

@@ -2256,6 +2256,89 @@ def test_wbatch_seam_real_tree_clean():
 
 
 # ---------------------------------------------------------------------------
+# meta-resilience-seam (ISSUE 14): engine calls route through the guard
+
+_MR_BASE_CLEAN = """
+class BaseMeta:
+    def configure_meta_retries(self, max_attempts=5):
+        if max_attempts <= 0:
+            return
+        self.resilience.configure(max_attempts=max_attempts)
+"""
+
+_MR_RES_CLEAN = """
+class MetaResilience:
+    def _call(self, name, fn, mutating, a, kw):
+        while True:
+            self._gate(mutating)
+            return fn(*a, **kw)
+"""
+
+
+def test_meta_resilience_seam_bare_engine_calls_fire(tmp_path):
+    report = _run(tmp_path, {"vfs/vfs.py": """
+        class VFS:
+            def nuke(self, ctx, parent, name):
+                return self.meta.do_unlink(ctx, parent, name)
+
+            def raw(self, fn):
+                return self.meta.client.txn(fn)
+    """, "chunk/ingest.py": """
+        class IngestPipeline:
+            def _lookup(self, tx_fn):
+                return self.meta.client.simple_txn(tx_fn)
+    """})
+    msgs = [f.message for f in report.findings
+            if f.rule == "meta-resilience-seam"]
+    assert any("do_unlink" in m for m in msgs), msgs
+    assert any("txn()" in m and "vfs/" in m for m in msgs), msgs
+    assert any("simple_txn()" in m and "chunk/" in m for m in msgs), msgs
+
+
+def test_meta_resilience_seam_disconnected_base_fires(tmp_path):
+    report = _run(tmp_path, {"meta/base.py": """
+        class BaseMeta:
+            def configure_meta_retries(self, max_attempts=5):
+                pass   # the contract is never installed
+    """, "meta/resilient.py": _MR_RES_CLEAN})
+    msgs = [f.message for f in report.findings
+            if f.rule == "meta-resilience-seam"]
+    assert any("configure_meta_retries" in m for m in msgs), msgs
+
+
+def test_meta_resilience_seam_gateless_guard_fires(tmp_path):
+    report = _run(tmp_path, {"meta/base.py": _MR_BASE_CLEAN,
+                             "meta/resilient.py": """
+        class MetaResilience:
+            def _call(self, name, fn, mutating, a, kw):
+                return fn(*a, **kw)   # no breaker gate: dead breaker
+    """})
+    msgs = [f.message for f in report.findings
+            if f.rule == "meta-resilience-seam"]
+    assert any("breaker" in m for m in msgs), msgs
+
+
+def test_meta_resilience_seam_routed_tree_clean(tmp_path):
+    report = _run(tmp_path, {"meta/base.py": _MR_BASE_CLEAN,
+                             "meta/resilient.py": _MR_RES_CLEAN,
+                             "vfs/vfs.py": """
+        class VFS:
+            def nuke(self, ctx, parent, name):
+                return self.meta.unlink(ctx, parent, name)
+    """})
+    assert not [f for f in report.findings
+                if f.rule == "meta-resilience-seam"], report.findings
+
+
+def test_meta_resilience_seam_real_tree_clean():
+    files = load_files()
+    from tools.analyze.passes import seams
+
+    assert not [f for f in seams.run_meta_resilience_seam(files)], \
+        [f.render() for f in seams.run_meta_resilience_seam(files)]
+
+
+# ---------------------------------------------------------------------------
 # claim-rollback: the wbatch overlay claim pair (ISSUE 13)
 
 def test_claim_rollback_wbatch_unprotected_acquire_fires(tmp_path):

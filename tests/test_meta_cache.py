@@ -671,3 +671,108 @@ def _fake_attr():
     from juicefs_tpu.meta.types import Attr
 
     return Attr(typ=1, mode=0o644)
+
+
+# ---------------------------------------------------------------------------
+# replica reconnect/re-SYNC edges (ISSUE 14 satellite)
+
+def test_heal_reprimes_floor_so_frozen_replica_demotes(server):
+    """The replica reconnect edge: a reader attached through an outage
+    has a floor frozen at its last observed epoch, while the primary
+    commits past it.  A replica that lost replication (it will re-SYNC,
+    but has not yet) still holds pre-outage state AT an epoch >= the
+    reader's stale floor — so the lag guard PASSES and serves pre-outage
+    state as fresh.  The heal hook must re-prime the floor from the
+    primary so the frozen replica demotes until it catches up."""
+    from juicefs_tpu.meta.cache import _REPLICA_STALE
+    from juicefs_tpu.meta.redis_server import RedisServer
+    from juicefs_tpu.meta.types import Attr, SET_ATTR_MODE
+
+    pport = int(server.split(":")[2].split("/")[0])
+    rep = RedisServer(replica_of=f"127.0.0.1:{pport}")
+    rport = rep.start()
+    try:
+        c0 = new_client(server)
+        c0.init(Format(name="refloor", trash_days=0), force=True)
+        c0.load()
+        st, ino, _ = c0.create(CTX, ROOT_INODE, b"f", 0o640)
+        assert st == 0
+        c0.close(CTX, ino)
+
+        # reader attaches: floor primed at the current epoch E
+        m = new_client(server)
+        m.load()
+        m.client.configure_replica(f"127.0.0.1:{rport}")
+        floor = m.client._epoch_floor
+        assert floor > 0
+
+        # replica catches up to E, then replication is SEVERED (the
+        # outage): it keeps serving its frozen pre-outage state
+        from juicefs_tpu.meta.redis_kv import RedisKV
+
+        probe = RedisKV(f"127.0.0.1:{rport}/0")
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            raw = probe.execute(b"GET", RedisKV.EPOCH_KEY)
+            if raw and int(raw) >= floor:
+                break
+            time.sleep(0.05)
+        probe.close()
+        rep._repl_stop.set()
+        pull = rep._repl_pull_conn
+        if pull is not None:
+            pull.close()
+
+        # the primary moves on (the writes the reader never observed)
+        st, _ = c0.setattr(CTX, ino, SET_ATTR_MODE, Attr(mode=0o600))
+        assert st == 0
+
+        # WITHOUT the re-prime the frozen replica passes the stale
+        # floor's guard and serves the pre-outage mode as fresh — that
+        # is the bug this satellite closes
+        st, attr = m.do_getattr(ino)
+        assert st == 0 and attr.mode & 0o777 == 0o640, \
+            "(pre-fix behavior proof: frozen replica admitted by stale floor)"
+
+        # heal hook: re-prime from the primary -> frozen replica demotes
+        before = _REPLICA_STALE.value
+        m.client.on_primary_heal()
+        assert m.client._epoch_floor > floor
+        st, attr = m.do_getattr(ino)
+        assert st == 0 and attr.mode & 0o777 == 0o600, \
+            "after the re-prime the read must demote to the primary's truth"
+        assert _REPLICA_STALE.value > before
+        assert m.client.primary_down is False
+        m.client.close()
+    finally:
+        rep.stop()
+
+
+def test_snapshot_payload_is_multi_exec_framed():
+    """The re-SYNC snapshot must apply ATOMICALLY on the replica: framed
+    MULTI..EXEC so the pull loop applies it under one lock hold.  Applied
+    command-by-command, a reader attached mid-re-SYNC could pass the
+    epoch guard (the !epoch key applies early — first-commit dict order)
+    while most of the namespace is still missing post-FLUSHDB."""
+    from juicefs_tpu.meta.redis_server import RedisServer, _Conn
+
+    pri = RedisServer()
+    port = pri.start()
+    try:
+        c0 = new_client(f"redis://127.0.0.1:{port}/0")
+        c0.init(Format(name="frame", trash_days=0), force=True)
+        c0.load()
+        st, ino, _ = c0.create(CTX, ROOT_INODE, b"f", 0o644)
+        assert st == 0
+        c0.close(CTX, ino)
+        c0.client.close()
+        with pri.lock:
+            payload = pri._snapshot_payload()
+    finally:
+        pri.stop()
+    assert payload.startswith(_Conn._enc([b"MULTI"])), \
+        "snapshot must open a MULTI frame"
+    assert payload.endswith(_Conn._enc([b"EXEC"])), \
+        "snapshot must close with EXEC (atomic apply on the replica)"
+    # the epoch key rides INSIDE the frame, with real volume data
+    assert b"!epoch" in payload and b"setting" in payload

@@ -836,3 +836,117 @@ def test_dirty_parent_refcount_across_overlapping_drains(tmp_path):
     assert st == 0
     assert {b"f1", b"f2"} <= {e.name for e in entries}
     m.close_session()
+
+
+# ---------------------------------------------------------------------------
+# session takeover under a meta outage (ISSUE 14 satellite)
+
+def test_session_survives_blackout_reap_and_heal_replays(tmp_path):
+    """A client whose session is reaped during a primary blackout must
+    re-register on heal (same sid) WITHOUT a second client having stolen
+    its in-flight wbatch inode range: prealloc ranges are monotonic
+    counter grants, so the absorbed creates commit under their acked
+    inos and the intruder's allocations stay disjoint."""
+    from juicefs_tpu.meta.redis_server import RedisServer
+    from juicefs_tpu.meta.resilient import BreakerState
+
+    aof = str(tmp_path / "takeover.aof")
+    pri = RedisServer(data_path=aof)
+    pport = pri.start()
+    url = f"redis://127.0.0.1:{pport}/0"
+    a = b = None
+    pri2 = None
+    try:
+        c0 = new_client(url)
+        c0.init(Format(name="reap", trash_days=0), force=True)
+        c0.load()
+        c0.client.close()
+
+        a = new_client(url)
+        a.load()
+        a.configure_meta_cache(attr_ttl=30.0, entry_ttl=30.0)
+        a.configure_write_batch(flush_ms=50.0, inode_prealloc=64)
+        a.configure_meta_retries(max_attempts=2, deadline=1.0,
+                                 degraded_max_stale=60.0, min_samples=4,
+                                 window=10.0, threshold=0.5,
+                                 probe_interval=0.2)
+        a.new_session()
+        a_sid = a.sid
+        st, dino, _ = a.mkdir(ROOT, ROOT_INODE, b"ckpt", 0o755)
+        assert st == 0
+        # warm the prealloc range + the parent lease before the blackout
+        st, warm, _ = a.create(ROOT, dino, b"warm", 0o644)
+        assert st == 0
+        assert a.sync_meta(warm) == 0
+        assert a.getattr(ROOT, dino)[0] == 0
+
+        # ---- BLACKOUT ----
+        pri.stop()
+        for _ in range(8):
+            if a.resilience.degraded:
+                break
+            try:
+                a.do_counter("reapprobe", 1)
+            except Exception:
+                pass
+        assert a.resilience.degraded
+
+        # in-flight absorbed creates on the preallocated range
+        acked = {}
+        for i in range(4):
+            nm = b"shard-%d" % i
+            st, ino, _ = a.create(ROOT, dino, nm, 0o644)
+            assert st == 0, "absorb must keep acking"
+            acked[nm] = ino
+
+        # ---- primary restarts; a peer reaps A's session and works ----
+        pri2 = RedisServer(port=pport, data_path=aof)
+        pri2.start()
+        b = new_client(url)
+        b.load()
+        b.do_clean_session(a_sid)  # the stale-session GC, force-aged
+        assert not b.do_session_exists(a_sid)
+        b.new_session()
+        b_inos = []
+        for i in range(4):
+            st, ino, _ = b.create(ROOT, dino, b"intruder-%d" % i, 0o644)
+            assert st == 0
+            b_inos.append(ino)
+
+        # ---- HEAL: A re-registers and replays ----
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if (a.resilience.breaker.state == BreakerState.CLOSED
+                    and not a.wbatch.has_pending()
+                    and b.do_session_exists(a_sid)):
+                break
+            time.sleep(0.05)
+        assert a.resilience.breaker.state == BreakerState.CLOSED
+        assert not a.wbatch.has_pending(), "heal must replay the queue"
+        assert b.do_session_exists(a_sid), \
+            "the reaped session must be re-registered under its sid"
+
+        # the replayed creates committed under their ACKED inos...
+        for nm, ino in acked.items():
+            st, got, _ = b.lookup(ROOT, dino, nm)
+            assert st == 0 and got == ino, \
+                "prealloc range did not survive the takeover"
+        # ...and the intruder's allocations never collided with them
+        assert not set(acked.values()) & set(b_inos), \
+            "a second client was handed A's in-flight inode range"
+        assert a.sync_meta() == 0
+    finally:
+        for cl in (a, b):
+            if cl is not None:
+                cl.resilience.close()
+                cl.wbatch.close()
+                try:
+                    cl.client.close()
+                except Exception:
+                    pass
+        if pri2 is not None:
+            pri2.stop()
+        try:
+            pri.stop()
+        except Exception:
+            pass

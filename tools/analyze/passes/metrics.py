@@ -99,6 +99,27 @@ META_THROTTLE_EXPECTED = {
     "juicefs_meta_throttle_waits",
     "juicefs_meta_throttle_wait_seconds",
 }
+META_FAULT_PREFIX = "juicefs_meta_fault_"
+META_FAULT_EXPECTED = {
+    # meta-plane fault contract (ISSUE 14, meta/resilient.py): retry/
+    # failure accounting per error class + hung-read abandonment
+    "juicefs_meta_fault_retries",
+    "juicefs_meta_fault_failures",
+    "juicefs_meta_fault_abandoned",
+}
+META_BREAKER_PREFIX = "juicefs_meta_breaker_"
+META_BREAKER_EXPECTED = {
+    # per-engine-connection circuit breaker (ISSUE 14)
+    "juicefs_meta_breaker_state",
+    "juicefs_meta_breaker_trips",
+    "juicefs_meta_breaker_resets",
+}
+META_STALE_PREFIX = "juicefs_meta_stale_"
+META_STALE_EXPECTED = {
+    # degraded-mode stale-lease serves, bounded by
+    # --meta-degraded-max-stale (ISSUE 14, meta/cache.py)
+    "juicefs_meta_stale_served",
+}
 META_WBATCH_PREFIX = "juicefs_meta_wbatch_"
 META_WBATCH_EXPECTED = {
     # checkpoint write plane (ISSUE 13, meta/wbatch.py): the
@@ -126,6 +147,7 @@ def populate_registry() -> None:
     import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
     import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
     import juicefs_tpu.meta.cache           # noqa: F401  lease cache + throttle
+    import juicefs_tpu.meta.resilient       # noqa: F401  meta fault contract
     import juicefs_tpu.meta.wbatch          # noqa: F401  write-batch plane
     import juicefs_tpu.metric.trace         # noqa: F401  stage rollup histogram
     import juicefs_tpu.object.metered       # noqa: F401  per-backend op meters
@@ -194,6 +216,10 @@ def run(files: list[SourceFile]) -> list[Finding]:
         + lint_pinned(META_CACHE_PREFIX, META_CACHE_EXPECTED, "meta-cache")
         + lint_pinned(META_THROTTLE_PREFIX, META_THROTTLE_EXPECTED,
                       "meta-throttle")
+        + lint_pinned(META_FAULT_PREFIX, META_FAULT_EXPECTED, "meta-fault")
+        + lint_pinned(META_BREAKER_PREFIX, META_BREAKER_EXPECTED,
+                      "meta-breaker")
+        + lint_pinned(META_STALE_PREFIX, META_STALE_EXPECTED, "meta-stale")
         + lint_pinned(META_WBATCH_PREFIX, META_WBATCH_EXPECTED,
                       "meta-wbatch")
         + lint_pinned(PREFETCH_PREFIX, PREFETCH_EXPECTED, "prefetch")

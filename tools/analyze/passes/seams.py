@@ -31,6 +31,7 @@ shim over these.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Optional
 
 from ..core import Finding, Pass, SourceFile, call_name, parent_map
@@ -483,20 +484,122 @@ def run_wbatch_seam(files: list[SourceFile]) -> list[Finding]:
     return findings
 
 
+# engine-txn entry points that must never be invoked from the consumer
+# layers: every engine interaction from vfs//chunk/ goes through a
+# BaseMeta public op so the ISSUE 14 fault guard (classified retries,
+# breaker gate, degraded mode) fronts it
+_META_TXN_CALLS = ("txn", "simple_txn", "group_txn")
+_DO_OP_RE = re.compile(r"^do_[a-z_]+$")
+
+
+def run_meta_resilience_seam(files: list[SourceFile]) -> list[Finding]:
+    """No bare engine ``do_*``/txn invocation from vfs/ or chunk/ —
+    bypassing the BaseMeta public ops bypasses the meta fault contract
+    (ISSUE 14): no classified retries, no breaker gate, no degraded
+    serving, so one engine hiccup becomes a raw exception on the FUSE
+    request path again — which no functional test catches until the
+    engine actually fails.  The contract itself must stay wired:
+    ``configure_meta_retries`` reaches ``resilience.configure`` and the
+    guard's call loop consults the breaker."""
+    findings: list[Finding] = []
+    base_sf = res_sf = None
+    saw_pkg = False
+    for sf in files:
+        saw_pkg = saw_pkg or sf.rel.startswith("juicefs_tpu/")
+        rel = _pkg_rel(sf)
+        if rel == "meta/base.py":
+            base_sf = sf
+        elif rel == "meta/resilient.py":
+            res_sf = sf
+        if sf.tree is None or rel.split("/", 1)[0] not in ("vfs", "chunk"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if _DO_OP_RE.match(attr):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "meta-resilience-seam",
+                    f"bare engine {attr} from {rel.split('/', 1)[0]}/ "
+                    "bypasses the meta fault contract (retries/breaker/"
+                    "degraded mode) — call the BaseMeta public op",
+                ))
+            elif attr in _META_TXN_CALLS:
+                findings.append(Finding(
+                    sf.rel, node.lineno, "meta-resilience-seam",
+                    f"bare engine {attr}() from {rel.split('/', 1)[0]}/ "
+                    "bypasses the meta fault contract — engine "
+                    "transactions belong behind BaseMeta public ops",
+                ))
+    if base_sf is not None and base_sf.tree is not None:
+        fn = None
+        for node in ast.walk(base_sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "BaseMeta":
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef) \
+                            and item.name == "configure_meta_retries":
+                        fn = item
+        if fn is None or not any(
+            isinstance(n, ast.Attribute) and n.attr == "resilience"
+            for n in ast.walk(fn)
+        ):
+            findings.append(Finding(
+                base_sf.rel, fn.lineno if fn else 0, "meta-resilience-seam",
+                "BaseMeta.configure_meta_retries never reaches the "
+                "resilience layer — the meta fault contract is "
+                "disconnected",
+            ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/meta/base.py", 0, "meta-resilience-seam",
+            "meta/base.py not found or unparseable",
+        ))
+    if res_sf is not None and res_sf.tree is not None:
+        # the guard's retry loop must consult the breaker — without the
+        # gate every "guarded" op dials a dead engine anyway and the
+        # degraded ladder never engages
+        call_fn = None
+        for node in ast.walk(res_sf.tree):
+            if isinstance(node, ast.ClassDef) \
+                    and node.name == "MetaResilience":
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef) \
+                            and item.name == "_call":
+                        call_fn = item
+        if call_fn is None or not any(
+            isinstance(n, ast.Attribute) and n.attr in ("_gate", "breaker")
+            for n in ast.walk(call_fn)
+        ):
+            findings.append(Finding(
+                res_sf.rel, call_fn.lineno if call_fn else 0,
+                "meta-resilience-seam",
+                "MetaResilience._call never consults the breaker gate — "
+                "the meta breaker is dead code",
+            ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/meta/resilient.py", 0, "meta-resilience-seam",
+            "meta/resilient.py not found or unparseable",
+        ))
+    return findings
+
+
 def run(files: list[SourceFile]) -> list[Finding]:
     return (run_qos_seam(files) + run_resilience_seam(files)
             + run_ingest_seam(files) + run_compress_seam(files)
             + run_meta_cache_seam(files) + run_prefetch_seam(files)
-            + run_wbatch_seam(files))
+            + run_wbatch_seam(files) + run_meta_resilience_seam(files))
 
 
 PASS = Pass(
     name="seams",
     rules=("qos-seam", "resilience-seam", "ingest-seam", "compress-seam",
-           "meta-cache-seam", "prefetch-seam", "wbatch-seam"),
+           "meta-cache-seam", "prefetch-seam", "wbatch-seam",
+           "meta-resilience-seam"),
     run=run,
     doc="architecture seams: scheduler-only pools, resilience-wrapped "
         "stores, ingest-guarded uploads, plane-routed compression, "
         "cache-routed vfs attr reads, prefetch-routed speculative reads, "
-        "batcher-routed vfs write mutations",
+        "batcher-routed vfs write mutations, guard-routed engine calls",
 )

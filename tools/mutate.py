@@ -113,6 +113,12 @@ TEST_MAP = {
                                   "tests/test_meta_dist.py"],
     "juicefs_tpu/meta/redis_server": ["tests/test_meta_cache.py",
                                       "tests/test_meta_dist.py"],
+    # ISSUE 14: meta-plane fault contract — classification, retry/
+    # deadline budget, breaker trip/probe/heal, degraded stale-lease
+    # serving, replica failover, wbatch absorb/replay, and the FaultyMeta
+    # injector's schedule/hang/throttle machinery are drilled there
+    "juicefs_tpu/meta/resilient": ["tests/test_meta_fault.py"],
+    "juicefs_tpu/meta/fault": ["tests/test_meta_fault.py"],
     # ISSUE 13: checkpoint write plane — group-commit batching, overlay
     # visibility, barrier/sticky-error contract, per-op replay, overload
     # shed, concurrent-writer coalescing are all drilled in test_wbatch
