@@ -2862,6 +2862,458 @@ def main_dataloader(argv=None) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# Gateway serving-plane bench (ISSUE 15): a concurrent GET/PUT/range/list
+# client mix through a REAL gateway socket, measured against a faithful
+# replica of the SEED gateway's data paths (whole-object RAM buffering,
+# full-bucket listing walk per request) over an identical volume on the
+# same host.  Plus two counter-asserted drills: duplicate-content PUTs
+# through the gateway elide their backend PUTs via the ingest plane, and
+# overload sheds as counted 503 SlowDown (never a queue, never a 500).
+
+def _gw_vol(block_kib: int = 256, with_ingest: bool = False):
+    import threading as _threading
+
+    from juicefs_tpu.chunk import (CachedStore, ChunkConfig, ContentRefs,
+                                   IngestPipeline)
+    from juicefs_tpu.fs import FileSystem
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.vfs import VFS
+
+    bs = block_kib << 10
+    m = new_client("mem://")
+    m.init(Format(name="gwbench", storage="mem", block_size=block_kib),
+           force=False)
+    m.new_session()
+
+    class _Counting:
+        def __init__(self, inner):
+            self._inner = inner
+            self.puts: list = []
+            self.lock = _threading.Lock()
+
+        def put(self, key, data):
+            with self.lock:
+                self.puts.append(key)
+            return self._inner.put(key, data)
+
+        def data_puts(self):
+            with self.lock:
+                return [k for k in self.puts if k.startswith("chunks/")]
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    counting = _Counting(create_storage("mem://"))
+    store = CachedStore(counting, ChunkConfig(block_size=bs))
+    if with_ingest:
+        refs = ContentRefs(m)
+        store.content_refs = refs
+        store.ingest = IngestPipeline(store, refs, backend="cpu",
+                                      batch_blocks=8, flush_timeout=0.005)
+    v = VFS(m, store)
+    return FileSystem(v), v, store, counting, bs
+
+
+def _seed_gateway_cls():
+    """Faithful replica of the SEED gateway's data paths (pre-ISSUE 15
+    s3.py), subclassing the live gateway so dispatch/auth/XML stay
+    identical and ONLY the data paths differ: GET whole-range pread into
+    one RAM buffer, PUT via whole-body `_body()`, ListObjectsV2 as a
+    full-bucket recursive walk + sort on every request."""
+    import errno as _errno
+    import posixpath as _pp
+    from xml.sax.saxutils import escape as _esc
+
+    from juicefs_tpu.fs import FSError
+    from juicefs_tpu.gateway import S3Gateway
+    from juicefs_tpu.gateway.s3 import NS, _etag, _http_date, _iso_date
+    from juicefs_tpu.meta.types import TYPE_DIRECTORY
+
+    class SeedGateway(S3Gateway):
+        def _get_object(self, h, t, bucket, key):
+            # faithful seed: parse Range, then ONE pread buffering the
+            # whole requested span in RAM before a single socket write
+            fs = t.fs
+            path = self._obj_path(bucket, key)
+            attr = fs.stat(path)
+            if attr.typ == TYPE_DIRECTORY:
+                raise FSError(_errno.ENOENT, key)
+            rng = h.headers.get("Range")
+            start, end, code = 0, attr.length - 1, 200
+            if rng and rng.startswith("bytes="):
+                spec = rng[6:].split("-")
+                if spec[0]:
+                    start = int(spec[0])
+                    if spec[1]:
+                        end = min(int(spec[1]), attr.length - 1)
+                else:
+                    start = max(0, attr.length - int(spec[1]))
+                code = 206
+            with fs.open(path) as f:
+                data = f.pread(start, end - start + 1) if attr.length else b""
+            h.send_response(code)
+            h.send_header("Content-Type", "application/octet-stream")
+            h.send_header("Content-Length", str(len(data)))
+            h.send_header("Last-Modified", _http_date(attr.mtime))
+            h.send_header("ETag", f'"{self._etag_of(fs, path, attr)}"')
+            if code == 206:
+                h.send_header("Content-Range",
+                              f"bytes {start}-{end}/{attr.length}")
+            h.end_headers()
+            h.wfile.write(data)
+
+        def _put_object(self, h, t, bucket, key):
+            fs = t.fs
+            fs.stat("/" + bucket)
+            data = h._body()
+            path = self._obj_path(bucket, key)
+            parent = _pp.dirname(path)
+            if parent != "/":
+                fs.makedirs(parent)
+            et = _etag(data)
+            with fs.create(path) as f:
+                if data:
+                    f.write(data)
+            h._empty(200, {"ETag": f'"{et}"'})
+
+        def _walk_all(self, fs, bucket, rel, out, prefix):
+            # faithful seed _walk incl. its prefix pruning — but NO
+            # token awareness: a continuation page still walks the
+            # whole matching subtree and filters afterwards
+            try:
+                entries = fs.listdir(
+                    f"/{bucket}/{rel}" if rel else f"/{bucket}",
+                    want_attr=True)
+            except FSError:
+                return
+            for e in entries:
+                name = e.name.decode()
+                if not rel and name.startswith("."):
+                    continue
+                key = f"{rel}{name}"
+                if e.attr and e.attr.typ == TYPE_DIRECTORY:
+                    dkey = key + "/"
+                    if prefix and not dkey.startswith(prefix[: len(dkey)]):
+                        continue
+                    if dkey.startswith(prefix) or prefix.startswith(dkey):
+                        self._walk_all(fs, bucket, dkey, out, prefix)
+                elif key.startswith(prefix):
+                    out.append((key, e.attr))
+
+        def _list_objects(self, h, t, bucket, q):
+            fs = t.fs
+            fs.stat("/" + bucket)
+            prefix = q.get("prefix", [""])[0]
+            max_keys = int(q.get("max-keys", ["1000"])[0])
+            token = q.get(
+                "continuation-token",
+                q.get("start-after", q.get("marker", [""]))
+            )[0]
+            keys: list = []
+            self._walk_all(fs, bucket, "", keys, prefix)  # full bucket
+            keys.sort(key=lambda kv: kv[0])
+            if token:
+                keys = [kv for kv in keys if kv[0] > token]
+            contents = keys[:max_keys]
+            body = "".join(
+                f"<Contents><Key>{_esc(k)}</Key>"
+                f"<LastModified>{_iso_date(a.mtime)}</LastModified>"
+                f"<Size>{a.length}</Size></Contents>"
+                for k, a in contents
+            )
+            h._xml(200, f'<ListBucketResult xmlns="{NS}">'
+                        f"<KeyCount>{len(contents)}</KeyCount>"
+                        + body + "</ListBucketResult>")
+
+    return SeedGateway
+
+
+def _gw_fill(fs, dirs: int, files: int, bs: int, large_blocks: int):
+    fs.mkdir("/bench")
+    small = b"s" * 64
+    for d in range(dirs):
+        fs.mkdir(f"/bench/d{d:02d}")
+        for i in range(files):
+            fs.write_file(f"/bench/d{d:02d}/f{i:04d}", small)
+    large = bytes(range(256)) * (bs // 256) * large_blocks
+    fs.write_file("/bench/large.bin", large)
+    fs.read_file("/bench/large.bin")  # warm the block cache
+    return large
+
+
+def _gw_drive(port: int, clients: int, ops: int, dirs: int, files: int,
+              large_len: int, bs: int) -> dict:
+    """The mixed workload: 40% list page / 30% small GET / 15% ranged
+    GET of the large object / 15% small PUT, per-client deterministic."""
+    import http.client
+    import random as _random
+    import threading as _threading
+
+    lock = _threading.Lock()
+    by_op = {"list": 0, "get": 0, "range": 0, "put": 0}
+    codes: dict = {}
+    errors: list = []
+
+    def req(conn, method, path, body=None, headers=None):
+        conn.request(method, path, body=body, headers=headers or {})
+        r = conn.getresponse()
+        data = r.read()
+        with lock:
+            codes[r.status] = codes.get(r.status, 0) + 1
+        return r.status, data
+
+    def worker(ci: int):
+        rng = _random.Random(4200 + ci)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            for i in range(ops):
+                r = rng.random()
+                if r < 0.40:
+                    d, f0 = rng.randrange(dirs), rng.randrange(files)
+                    st, _ = req(conn, "GET",
+                                "/bench?list-type=2&max-keys=50"
+                                f"&start-after=d{d:02d}/f{f0:04d}")
+                    op = "list"
+                elif r < 0.70:
+                    d, f = rng.randrange(dirs), rng.randrange(files)
+                    st, _ = req(conn, "GET", f"/bench/d{d:02d}/f{f:04d}")
+                    op = "get"
+                elif r < 0.85:
+                    start = rng.randrange(max(1, large_len - (64 << 10)))
+                    st, _ = req(conn, "GET", "/bench/large.bin",
+                                headers={"Range":
+                                         f"bytes={start}-{start + (64 << 10) - 1}"})
+                    op = "range"
+                else:
+                    st, _ = req(conn, "PUT", f"/bench/w/c{ci}/o{i}",
+                                body=b"w" * 4096)
+                    op = "put"
+                with lock:
+                    by_op[op] += 1
+                    if st >= 500:
+                        errors.append((op, st))
+        finally:
+            conn.close()
+
+    threads = [_threading.Thread(target=worker, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    total = clients * ops
+    return {"wall_s": round(wall, 3), "ops": total,
+            "ops_per_s": round(total / wall, 1), "by_op": by_op,
+            "codes": codes, "server_errors": errors}
+
+
+def _gw_overload_drill(max_inflight: int = 4, arrivals: int = 16) -> dict:
+    """Deterministic overload: park `max_inflight` cold GETs on an
+    event-blocked backend, then fire further arrivals — every one must
+    shed as 503 SlowDown (counted), never queue, never 500."""
+    import http.client
+    import threading as _threading
+
+    from juicefs_tpu.chunk import CachedStore, ChunkConfig
+    from juicefs_tpu.fs import FileSystem
+    from juicefs_tpu.gateway import S3Gateway
+    from juicefs_tpu.meta import Format, new_client
+    from juicefs_tpu.object import create_storage
+    from juicefs_tpu.vfs import VFS
+
+    class _Blocking:
+        def __init__(self, inner):
+            self._inner = inner
+            self.release = _threading.Event()
+
+        def get(self, key, off=0, limit=-1):
+            self.release.wait(30.0)
+            return self._inner.get(key, off, limit)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    m = new_client("mem://")
+    m.init(Format(name="gwshed", storage="mem", block_size=256), force=False)
+    m.new_session()
+    blocking = _Blocking(create_storage("mem://"))
+    store = CachedStore(blocking, ChunkConfig(block_size=256 << 10,
+                                              cache_size=1, hedge=False))
+    v = VFS(m, store)
+    fs = FileSystem(v)
+    fs.mkdir("/b")
+    blocking.release.set()
+    fs.write_file("/b/cold.bin", b"z" * (128 << 10))
+    gw = S3Gateway(fs, port=0, max_inflight=max_inflight)
+    port = gw.start()
+    codes: list = []
+    lock = _threading.Lock()
+
+    def one_get():
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            c.request("GET", "/b/cold.bin")
+            r = c.getresponse()
+            r.read()
+            with lock:
+                codes.append(r.status)
+        finally:
+            c.close()
+
+    try:
+        blocking.release.clear()
+        parked = [_threading.Thread(target=one_get)
+                  for _ in range(max_inflight)]
+        for t in parked:
+            t.start()
+        deadline = time.monotonic() + 10.0
+        while gw.plane.gate.inflight < max_inflight \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        burst = [_threading.Thread(target=one_get)
+                 for _ in range(arrivals - max_inflight)]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join()
+        blocking.release.set()
+        for t in parked:
+            t.join()
+    finally:
+        blocking.release.set()
+        gw.stop()
+        v.close()
+        store.close()
+    return {
+        "max_inflight": max_inflight,
+        "arrivals": arrivals,
+        "served_200": sum(1 for c in codes if c == 200),
+        "shed_503": sum(1 for c in codes if c == 503),
+        "other_5xx": sum(1 for c in codes if c >= 500 and c != 503),
+        "gate_shed_counter": gw.plane.gate.shed,
+    }
+
+
+def _gw_dup_sweep(keys: int = 12, bs: int = 256 << 10) -> dict:
+    """PUT identical 2-block content under `keys` distinct keys through
+    a real gateway socket over an ingest-enabled store: every duplicate
+    block's backend PUT must be ELIDED (zero dup PUTs)."""
+    import http.client
+
+    from juicefs_tpu.gateway import S3Gateway
+
+    fs, v, store, counting, bs = _gw_vol(block_kib=bs >> 10,
+                                         with_ingest=True)
+    content = bytes([5]) * bs + bytes([6]) * bs
+    gw = S3Gateway(fs, port=0)
+    port = gw.start()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("PUT", "/b")
+        conn.getresponse().read()
+        statuses = []
+        for i in range(keys):
+            conn.request("PUT", f"/b/dup{i:03d}.bin", body=content)
+            r = conn.getresponse()
+            r.read()
+            statuses.append(r.status)
+            store.ingest.flush(5.0)
+        data_puts = len(counting.data_puts())
+        # byte-identity spot check through the gateway read path
+        conn.request("GET", f"/b/dup{keys - 1:03d}.bin")
+        r = conn.getresponse()
+        identical = r.read() == content and r.status == 200
+    finally:
+        conn.close()
+        gw.stop()
+        v.close()
+        store.close()
+    total_blocks = keys * 2
+    return {
+        "keys": keys,
+        "blocks_written": total_blocks,
+        "unique_blocks": 2,
+        "backend_data_puts": data_puts,
+        "dup_puts": max(0, data_puts - 2),
+        "elided": total_blocks - data_puts,
+        "readback_identical": bool(identical),
+        "all_200": all(s == 200 for s in statuses),
+    }
+
+
+def run_gateway_bench(clients: int = 8, ops: int = 60, dirs: int = 100,
+                      files: int = 100, large_blocks: int = 16,
+                      block_kib: int = 256) -> dict:
+    """Headline: mixed-workload ops/s, live serving plane vs the seed
+    replica on the same host (acceptance >= 3x), plus the overload and
+    dup-sweep drills."""
+    from juicefs_tpu.gateway import S3Gateway
+
+    def one(gw_cls) -> dict:
+        fs, v, store, counting, bs = _gw_vol(block_kib=block_kib)
+        large = _gw_fill(fs, dirs, files, bs, large_blocks)
+        gw = gw_cls(fs, port=0, max_inflight=256)
+        port = gw.start()
+        try:
+            out = _gw_drive(port, clients, ops, dirs, files, len(large), bs)
+            out["plane"] = gw.plane.stats()
+        finally:
+            gw.stop()
+            v.close()
+            store.close()
+        return out
+
+    seed = one(_seed_gateway_cls())
+    live = one(S3Gateway)
+    speedup = live["ops_per_s"] / max(seed["ops_per_s"], 1e-9)
+    return {
+        "config": {"clients": clients, "ops_per_client": ops,
+                   "bucket_keys": dirs * files + 1, "dirs": dirs,
+                   "large_object_mib": (large_blocks * (block_kib << 10))
+                   >> 20,
+                   "block_kib": block_kib,
+                   "mix": {"list": 0.40, "get": 0.30, "range": 0.15,
+                           "put": 0.15}},
+        "seed_replica": seed,
+        "serving_plane": live,
+        "speedup": round(speedup, 2),
+        "overload": _gw_overload_drill(),
+        "dup_sweep": _gw_dup_sweep(bs=block_kib << 10),
+    }
+
+
+def main_gateway(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gateway", action="store_true")
+    ap.add_argument("--gw-clients", type=int, default=8)
+    ap.add_argument("--gw-ops", type=int, default=60)
+    ap.add_argument("--gw-dirs", type=int, default=100)
+    ap.add_argument("--gw-files", type=int, default=100)
+    args, _ = ap.parse_known_args(argv)
+    res = run_gateway_bench(clients=args.gw_clients, ops=args.gw_ops,
+                            dirs=args.gw_dirs, files=args.gw_files)
+    print(json.dumps({
+        "metric": "gateway_mixed_throughput",
+        "value": res["serving_plane"]["ops_per_s"],
+        "unit": "ops/s (concurrent GET/PUT/range/list mix through a real "
+                "gateway socket; acceptance >= 3x the seed gateway, "
+                "overload sheds 503 never 500, zero dup PUTs)",
+        "vs_seed": res["speedup"],
+        "acceptance": {
+            "speedup_ge_3x": res["speedup"] >= 3.0,
+            "overload_shed_503": res["overload"]["shed_503"],
+            "overload_other_5xx": res["overload"]["other_5xx"],
+            "zero_dup_puts": res["dup_sweep"]["dup_puts"] == 0,
+        },
+        "gateway": res,
+    }))
+    return 0
+
+
 def main_qos(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--qos", action="store_true")
@@ -2936,6 +3388,8 @@ if __name__ == "__main__":
         sys.exit(main_e2e())
     if "--ingest" in sys.argv:
         sys.exit(main_ingest())
+    if "--gateway" in sys.argv:
+        sys.exit(main_gateway())
     if "--qos" in sys.argv:
         sys.exit(main_qos())
     if "--meta-scale" in sys.argv:

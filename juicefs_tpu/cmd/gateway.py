@@ -25,6 +25,14 @@ def add_parser(sub):
                    "(or MINIO_ROOT_USER); auth disabled when empty")
     g.add_argument("--secret-key", default="", help="SigV4 secret key "
                    "(or MINIO_ROOT_PASSWORD)")
+    g.add_argument("--tenant-key", action="append", default=[],
+                   metavar="ACCESS:SECRET",
+                   help="additional SigV4 key pair mapped to its own "
+                        "tenant (repeatable; each key gets its own DRR "
+                        "fair-queue identity)")
+    g.add_argument("--max-inflight", type=int, default=64,
+                   help="admission-gate bound: requests past it shed as "
+                        "503 SlowDown instead of queueing")
     g.set_defaults(func=run_gateway)
 
     w = sub.add_parser("webdav", help="serve the volume over WebDAV")
@@ -82,7 +90,17 @@ def run_gateway(args) -> int:
     # gateway reads (cmd/gateway.go MINIO_ROOT_USER/PASSWORD)
     ak = args.access_key or os.environ.get("MINIO_ROOT_USER", "")
     sk = args.secret_key or os.environ.get("MINIO_ROOT_PASSWORD", "")
-    gw = S3Gateway(fs, args.address, args.port, access_key=ak, secret_key=sk)
+    tenant_keys = {}
+    for pair in getattr(args, "tenant_key", []):
+        tak, _, tsk = pair.partition(":")
+        if not tak or not tsk:
+            raise SystemExit(f"--tenant-key needs ACCESS:SECRET, got {pair!r}")
+        tenant_keys[tak] = tsk
+    gw = S3Gateway(
+        fs, args.address, args.port, access_key=ak, secret_key=sk,
+        tenant_keys=tenant_keys,
+        max_inflight=getattr(args, "max_inflight", 64),
+    )
     port = gw.start()
     return _serve_forever(vfs, m, gw, "S3 gateway", port,
                           getattr(args, "metrics", ""))

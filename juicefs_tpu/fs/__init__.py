@@ -282,6 +282,30 @@ class FileSystem:
         flags = os.O_RDWR | os.O_CREAT | (os.O_TRUNC if overwrite else os.O_EXCL)
         return self.open(path, flags, mode)
 
+    def copy_range(self, src: str, dst: str, off_out: int = 0,
+                   off_in: int = 0, size: int = -1) -> int:
+        """Server-side copy by slice-reference sharing (vfs
+        copy_file_range over meta slice increfs): no data bytes move —
+        the gateway's CompleteMultipartUpload and CopyObject stitch at
+        the metadata level instead of read+rewrite.  ``dst`` must exist
+        (create it first); returns bytes copied."""
+        st, fin, sattr = self.resolve(src)
+        if st:
+            raise FSError(st, src)
+        st, fout, _ = self.resolve(dst)
+        if st:
+            raise FSError(st, dst)
+        if size < 0:
+            size = max(0, sattr.length - off_in)
+        if size == 0:
+            return 0
+        st, copied = self.vfs.copy_file_range(
+            self.ctx, fin, off_in, fout, off_out, size
+        )
+        if st:
+            raise FSError(st, dst)
+        return copied
+
     def read_file(self, path: str) -> bytes:
         with self.open(path) as f:
             return f.read()

@@ -36,6 +36,7 @@ class BaseHandler(BaseHTTPRequestHandler):
 
     def handle_one_request(self):
         self._gw_span = None
+        self._consumed = 0  # request-body bytes already read off rfile
         try:
             super().handle_one_request()
         finally:
@@ -44,16 +45,37 @@ class BaseHandler(BaseHTTPRequestHandler):
             if sp is not None:
                 sp.__exit__(None, None, None)
 
-    def _body(self) -> bytes:
+    def _remaining(self) -> int:
         n = int(self.headers.get("Content-Length", 0) or 0)
-        remaining, chunks = n, []
+        return max(0, n - getattr(self, "_consumed", 0))
+
+    def _note_consumed(self, n: int) -> None:
+        """Credit body bytes a streaming helper read off rfile."""
+        self._consumed += n
+
+    def _body(self) -> bytes:
+        """Buffer the (remaining) request body — control payloads only;
+        object data paths stream through gateway/serve.py instead."""
+        remaining, chunks = self._remaining(), []
         while remaining > 0:
             chunk = self.rfile.read(min(remaining, 1 << 20))
             if not chunk:
                 break
             chunks.append(chunk)
+            self._consumed += len(chunk)
             remaining -= len(chunk)
         return b"".join(chunks)
+
+    def _drain(self) -> None:
+        """Discard the unread body so an error reply does not desync the
+        keep-alive stream (idempotent: already-streamed bytes count)."""
+        remaining = self._remaining()
+        while remaining > 0:
+            chunk = self.rfile.read(min(remaining, 1 << 20))
+            if not chunk:
+                break
+            self._consumed += len(chunk)
+            remaining -= len(chunk)
 
     def _empty(self, code: int = 200, headers: dict | None = None):
         headers = headers or {}

@@ -17,6 +17,7 @@ from ..meta.types import TYPE_DIRECTORY
 from ..utils import get_logger
 from ..fs import FSError, FileSystem
 from . import BaseHandler, HTTPAdapter
+from .serve import UNSATISFIABLE, parse_range, stream_body_in, stream_file_out
 
 logger = get_logger("gateway.webdav")
 
@@ -73,51 +74,58 @@ class WebDAVServer(HTTPAdapter):
                 self.wfile.write(body)
 
             def do_GET(self):
+                """Streaming GET on the same read path as the S3 gateway
+                (ISSUE 15 satellite): block-sized spans ride the vfs
+                streaming reader — never one whole-object RAM buffer —
+                and Range semantics come from the ONE shared parser
+                (gateway/serve.py parse_range)."""
                 try:
                     attr = dav.fs.stat(self._path())
                     if attr.typ == TYPE_DIRECTORY:
                         return self._empty(405)
-                    data = dav.fs.read_file(self._path())
                 except FSError as e:
                     return self._err(e)
-                # RFC 7233 single byte-range (bytes=a-b / bytes=a- ); an
-                # invalid spec (inverted or unparsable) ignores the header
-                start = None
-                rng = self.headers.get("Range", "")
-                if rng.startswith("bytes=") and "," not in rng:
-                    total = len(data)
-                    try:
-                        a, _, b = rng[6:].partition("-")
-                        if a and b:
-                            s, e = int(a), min(int(b), total - 1)
-                            valid = s >= 0 and int(b) >= s  # inverted -> ignore
-                        elif a:
-                            s, e = int(a), total - 1
-                            valid = s >= 0
-                        else:
-                            # suffix-range: last N bytes; N must be a plain
-                            # non-negative integer or the spec is invalid
-                            valid = b.isdigit()
-                            s, e = (max(0, total - int(b)), total - 1) if valid else (0, 0)
-                        if valid:
-                            if s >= total:
-                                return self._empty(416)  # unsatisfiable
-                            start, end = s, e
-                    except ValueError:
-                        pass  # malformed: ignore the header (RFC 7233)
-                if start is not None:
-                    part = data[start:end + 1]
-                    self.send_response(206)
-                    self.send_header("Content-Range",
-                                     f"bytes {start}-{end}/{total}")
-                    self.send_header("Content-Length", str(len(part)))
+                total = attr.length
+                rng = parse_range(self.headers.get("Range", ""), total)
+                if rng is UNSATISFIABLE:
+                    return self._empty(416)
+                if rng is None:
+                    start, end, code = 0, total - 1, 200
+                else:
+                    (start, end), code = rng, 206
+                length = end - start + 1 if total else 0
+                if not length:
+                    self.send_response(code)
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
-                    self.wfile.write(part)
                     return
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                # first span BEFORE the headers commit: a failing read
+                # still maps to a clean error; only a mid-stream failure
+                # degrades to a closed connection
+                try:
+                    with dav.fs.open(self._path()) as f:
+                        span = dav._span()
+                        first = f.pread(start, min(span, length))
+                        self.send_response(code)
+                        if code == 206:
+                            self.send_header(
+                                "Content-Range",
+                                f"bytes {start}-{end}/{total}")
+                        self.send_header("Content-Length", str(length))
+                        self.end_headers()
+                        self.wfile.write(first)
+                        sent = len(first)
+                        if sent == len(first) and sent < length:
+                            try:
+                                sent += stream_file_out(
+                                    self.wfile, f, start + sent,
+                                    length - sent, span)
+                            except OSError:
+                                pass  # headers committed: close below
+                except FSError as e:
+                    return self._err(e)
+                if sent < length:
+                    self.close_connection = True  # truncated mid-stream
 
             def do_HEAD(self):
                 try:
@@ -127,14 +135,41 @@ class WebDAVServer(HTTPAdapter):
                 self._empty(200, {"Content-Length": str(attr.length)})
 
             def do_PUT(self):
-                data = self._body()
+                """Streaming PUT: the body flows into the vfs writer in
+                block-sized pieces (ingest/dedup/compress engage), same
+                data path as S3 PUT — including the temp+rename publish,
+                so a failed overwrite never destroys the previous
+                version of the resource."""
+                import uuid as _uuid
+
                 path = self._path()
+                length = self._remaining()
+                tmp = f"/.sys/tmp/{_uuid.uuid4().hex}"
                 try:
                     parent = posixpath.dirname(path.rstrip("/"))
                     if parent and parent != "/" and not dav.fs.exists(parent):
+                        self._drain()
                         return self._empty(409)  # RFC: no implicit collections
-                    dav.fs.write_file(path, data)
+                    dav.fs.makedirs("/.sys/tmp")
+                    with dav.fs.create(tmp) as f:
+                        _et, got, _ok = stream_body_in(
+                            self.rfile, f, length, dav._span(),
+                            consumed=self._note_consumed)
                 except FSError as e:
+                    self._drain()
+                    dav._discard(tmp)
+                    return self._err(e)
+                if got < length:
+                    # client truncated the body: drop the temp and the
+                    # (desynced) connection — the live resource, if
+                    # any, is untouched
+                    dav._discard(tmp)
+                    self.close_connection = True
+                    return self._empty(400)
+                try:
+                    dav.fs.rename(tmp, path)
+                except FSError as e:
+                    dav._discard(tmp)
                     return self._err(e)
                 self._empty(201)
 
@@ -193,12 +228,30 @@ class WebDAVServer(HTTPAdapter):
                     overwrote = dav.fs.exists(dst)
                     if overwrote and self.headers.get("Overwrite", "T") == "F":
                         return self._empty(412)
-                    dav.fs.write_file(dst, dav.fs.read_file(self._path()))
+                    if dst == self._path():
+                        # copy onto itself: truncating the destination
+                        # would destroy the source — a no-op replace
+                        return self._empty(204)
+                    # server-side slice share: no data bytes move
+                    with dav.fs.create(dst):
+                        pass
+                    dav.fs.copy_range(self._path(), dst)
                 except FSError as e:
                     return self._err(e)
                 self._empty(204 if overwrote else 201)
 
         self._handler_cls = Handler
+
+    def _span(self) -> int:
+        """Streaming span: one block per piece, the same granularity the
+        chunk store caches and the readahead window grows by."""
+        return int(self.fs.vfs.store.conf.block_size)
+
+    def _discard(self, path: str) -> None:
+        try:
+            self.fs.unlink(path)
+        except FSError:
+            pass  # unwind of a failed PUT: the temp may never have landed
 
     def _propfind(self, path: str, depth: str) -> list[str]:
         attr = self.fs.stat(path)

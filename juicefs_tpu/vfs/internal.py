@@ -291,6 +291,17 @@ class InternalFiles:
                 mp["session"] = {"sid": meta.sid,
                                  "beat_failures": meta._beat_failures}
             out["meta_plane"] = mp
+        # gateway serving plane (ISSUE 15): admission gate occupancy,
+        # shed count, per-tenant request rates, streaming-buffer bounds —
+        # present only when a gateway adapter serves this vfs
+        try:
+            from ..gateway.serve import status_for
+
+            gw = status_for(self.vfs)
+            if gw is not None:
+                out["gateway"] = gw
+        except Exception:
+            pass  # a torn-down adapter must never break a status read
         # unified I/O scheduler + bandwidth budget (ISSUE 6): lane/queue
         # occupancy per class and token-bucket levels
         sched = getattr(store, "scheduler", None)

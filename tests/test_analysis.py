@@ -2396,3 +2396,99 @@ def test_claim_rollback_wbatch_clean_shape(tmp_path):
     """})
     assert not [f for f in report.findings if f.rule == "claim-rollback"], \
         report.findings
+
+
+# ---------------------------------------------------------------------------
+# gateway-seam (ISSUE 15): data paths stream, dispatch is admitted/tagged
+
+def test_gateway_seam_buffered_data_paths_fire(tmp_path):
+    report = _run(tmp_path, {"gateway/s3.py": """
+        class S3Gateway:
+            def do_GET(self):
+                return self._get_object(self, "b", "k")
+
+            def _get_object(self, h, bucket, key):
+                data = self.fs.read_file(key)   # whole object in RAM
+                h.wfile.write(data)
+
+            def _put_object(self, h, bucket, key):
+                data = h._body()                # whole body in RAM
+                self.fs.write_file(key, data)
+    """, "gateway/webdav.py": """
+        class WebDAVServer:
+            def do_GET(self):
+                self.wfile.write(self.fs.read_file(self._path()))
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "gateway-seam"]
+    # whole-object buffering named on both adapters
+    assert sum("read_file" in m for m in msgs) >= 2, msgs
+    assert any("_put_object" in m and "_body" in m for m in msgs), msgs
+    # both data paths lost the streaming seam
+    assert any("_get_object" in m and "seam is gone" in m for m in msgs)
+    assert any("do_GET" in m and "seam is gone" in m for m in msgs)
+    # s3 dispatch outside the admission gate
+    assert any("admitted" in m and "do_GET" in m for m in msgs), msgs
+
+
+def test_gateway_seam_tenantless_admitted_fires(tmp_path):
+    report = _run(tmp_path, {"gateway/serve.py": """
+        class ServingPlane:
+            def admitted(self, op, tenant=None):
+                if not self.gate.try_enter():
+                    return None
+                return self   # no tenant_scope: requests run tenant-blind
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "gateway-seam"]
+    assert any("tenant_scope" in m for m in msgs), msgs
+
+
+def test_gateway_seam_streaming_tree_clean(tmp_path):
+    report = _run(tmp_path, {"gateway/s3.py": """
+        class S3Gateway:
+            def do_GET(self):
+                with self.plane.admitted("get", t) as adm:
+                    return self._get_object(self, "b", "k")
+
+            def do_PUT(self):
+                with self.plane.admitted("put", t) as adm:
+                    return self._put_object(self, "b", "k")
+
+            def _get_object(self, h, bucket, key):
+                with self.fs.open(key) as f:
+                    self.plane.stream_out(h.wfile, f, 0, 10)
+
+            def _put_object(self, h, bucket, key):
+                with self.fs.create(key) as f:
+                    self.plane.stream_in(h.rfile, f, 10)
+    """, "gateway/serve.py": """
+        from ..qos import tenant_scope
+
+        class ServingPlane:
+            def admitted(self, op, tenant=None):
+                with tenant_scope(tenant.uid if tenant else 0):
+                    yield self
+    """, "gateway/webdav.py": """
+        from .serve import stream_body_in, stream_file_out
+
+        class WebDAVServer:
+            def do_GET(self):
+                with self.fs.open(self._path()) as f:
+                    stream_file_out(self.wfile, f, 0, 10, 4096)
+
+            def do_PUT(self):
+                with self.fs.create(self._path()) as f:
+                    stream_body_in(self.rfile, f, 10, 4096)
+
+            def do_COPY(self):
+                self.fs.copy_range(self._path(), self._dest())
+    """})
+    assert not [f for f in report.findings if f.rule == "gateway-seam"], \
+        report.findings
+
+
+def test_gateway_seam_real_tree_clean():
+    files = load_files()
+    from tools.analyze.passes import seams
+
+    assert not [f for f in seams.run_gateway_seam(files)], \
+        [f.render() for f in seams.run_gateway_seam(files)]

@@ -120,6 +120,22 @@ META_STALE_EXPECTED = {
     # --meta-degraded-max-stale (ISSUE 14, meta/cache.py)
     "juicefs_meta_stale_served",
 }
+GATEWAY_PREFIX = "juicefs_gateway_"
+GATEWAY_EXPECTED = {
+    # gateway serving plane (ISSUE 15, gateway/serve.py): admission,
+    # tenancy and streaming-buffer accounting — the shed counter and the
+    # stream-buffer gauge are acceptance counters (503-not-500 overload,
+    # bounded per-request buffering)
+    "juicefs_gateway_requests",
+    "juicefs_gateway_shed",
+    "juicefs_gateway_errors",
+    "juicefs_gateway_auth_failures",
+    "juicefs_gateway_bytes_in",
+    "juicefs_gateway_bytes_out",
+    "juicefs_gateway_request_seconds",
+    "juicefs_gateway_inflight",
+    "juicefs_gateway_stream_buffer_bytes",
+}
 META_WBATCH_PREFIX = "juicefs_meta_wbatch_"
 META_WBATCH_EXPECTED = {
     # checkpoint write plane (ISSUE 13, meta/wbatch.py): the
@@ -146,6 +162,7 @@ def populate_registry() -> None:
     import juicefs_tpu.chunk.parallel       # noqa: F401  fetch_inflight gauge
     import juicefs_tpu.chunk.prefetch       # noqa: F401  prefetch effectiveness
     import juicefs_tpu.chunk.singleflight   # noqa: F401  dedup counters
+    import juicefs_tpu.gateway.serve        # noqa: F401  serving-plane counters
     import juicefs_tpu.meta.cache           # noqa: F401  lease cache + throttle
     import juicefs_tpu.meta.resilient       # noqa: F401  meta fault contract
     import juicefs_tpu.meta.wbatch          # noqa: F401  write-batch plane
@@ -224,6 +241,7 @@ def run(files: list[SourceFile]) -> list[Finding]:
                       "meta-wbatch")
         + lint_pinned(PREFETCH_PREFIX, PREFETCH_EXPECTED, "prefetch")
         + lint_pinned(READAHEAD_PREFIX, READAHEAD_EXPECTED, "readahead")
+        + lint_pinned(GATEWAY_PREFIX, GATEWAY_EXPECTED, "gateway")
     )
     return [Finding("", 0, "metric-registry", p) for p in problems]
 

@@ -585,21 +585,133 @@ def run_meta_resilience_seam(files: list[SourceFile]) -> list[Finding]:
     return findings
 
 
+# object data-path functions: whole-body buffering (`_body`) or
+# whole-object reads (`read_file`) there silently revert the gateway to
+# RAM-buffered serving — results stay byte-identical, only the memory
+# bound and the streaming-reader engagement vanish, which no functional
+# test catches
+_GW_DATA_PATHS = {
+    "gateway/s3.py": ("_get_object", "_put_object", "_upload_part"),
+    "gateway/webdav.py": ("do_GET", "do_PUT", "do_COPY"),
+}
+# the streaming helpers each adapter must actually reach
+# (_stream_to_temp is the s3 adapter's temp-key wrapper OVER stream_in:
+# the body still streams, it just lands behind an atomic rename)
+_GW_STREAM_CALLS = {"stream_in", "stream_out", "stream_body_in",
+                    "stream_file_out", "_stream_to_temp"}
+# the s3 handler dispatch methods that must pass the admission gate
+_GW_DISPATCH = ("do_GET", "do_HEAD", "do_PUT", "do_POST", "do_DELETE")
+
+
+def run_gateway_seam(files: list[SourceFile]) -> list[Finding]:
+    """Gateway data paths stream and dispatch is admission/qos-tagged
+    (ISSUE 15): object bodies must move through the serving-plane
+    streaming helpers (no ``fs.read_file``, no ``_body()`` buffering in
+    a data path), every s3 dispatch method must enter ``admitted`` (the
+    gate that sheds overload and applies the tenant scope), and the
+    serving plane itself must reach ``tenant_scope`` — a refactor that
+    drops any of these quietly reverts the gateway to unbounded
+    RAM-buffered, tenant-blind serving."""
+    findings: list[Finding] = []
+    s3_sf = serve_sf = None
+    saw_pkg = False
+    for sf in files:
+        saw_pkg = saw_pkg or sf.rel.startswith("juicefs_tpu/")
+        rel = _pkg_rel(sf)
+        if rel == "gateway/s3.py":
+            s3_sf = sf
+        elif rel == "gateway/serve.py":
+            serve_sf = sf
+        if not rel.startswith("gateway/") or sf.tree is None:
+            continue
+        if rel == "gateway/serve.py":
+            continue  # the helper layer itself
+        data_fns = _GW_DATA_PATHS.get(rel, ())
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr == "read_file":
+                findings.append(Finding(
+                    sf.rel, node.lineno, "gateway-seam",
+                    "fs.read_file in a gateway adapter buffers a whole "
+                    "object in RAM — stream through the serving-plane "
+                    "helpers (gateway/serve.py)",
+                ))
+        for fn in _fn_defs(sf.tree, data_fns):
+            streams = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name == "_body":
+                    findings.append(Finding(
+                        sf.rel, node.lineno, "gateway-seam",
+                        f"{fn.name} buffers the request body (_body) — "
+                        "object data paths must stream "
+                        "(serve.stream_body_in / plane.stream_in)",
+                    ))
+                if name in _GW_STREAM_CALLS or name == "copy_range":
+                    streams = True
+            if not streams:
+                findings.append(Finding(
+                    sf.rel, fn.lineno, "gateway-seam",
+                    f"{fn.name} never reaches a streaming helper "
+                    "(stream_in/stream_out/copy_range) — the gateway "
+                    "data-path seam is gone",
+                ))
+    if s3_sf is not None and s3_sf.tree is not None:
+        for fn in _fn_defs(s3_sf.tree, _GW_DISPATCH):
+            if not any(isinstance(n, ast.Attribute) and n.attr == "admitted"
+                       for n in ast.walk(fn)):
+                findings.append(Finding(
+                    s3_sf.rel, fn.lineno, "gateway-seam",
+                    f"{fn.name} dispatches outside the admission gate "
+                    "(plane.admitted) — overload would queue unboundedly "
+                    "and the request would run tenant-blind",
+                ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/gateway/s3.py", 0, "gateway-seam",
+            "gateway/s3.py not found or unparseable",
+        ))
+    if serve_sf is not None and serve_sf.tree is not None:
+        adm = next((f for f in _fn_defs(serve_sf.tree, ("admitted",))), None)
+        if adm is None or not any(
+            isinstance(n, ast.Name) and n.id == "tenant_scope"
+            for n in ast.walk(adm)
+        ):
+            findings.append(Finding(
+                serve_sf.rel, adm.lineno if adm else 0, "gateway-seam",
+                "ServingPlane.admitted never applies tenant_scope — "
+                "admitted requests would run tenant-blind on the qos "
+                "lanes",
+            ))
+    elif saw_pkg:
+        findings.append(Finding(
+            "juicefs_tpu/gateway/serve.py", 0, "gateway-seam",
+            "gateway/serve.py not found or unparseable",
+        ))
+    return findings
+
+
 def run(files: list[SourceFile]) -> list[Finding]:
     return (run_qos_seam(files) + run_resilience_seam(files)
             + run_ingest_seam(files) + run_compress_seam(files)
             + run_meta_cache_seam(files) + run_prefetch_seam(files)
-            + run_wbatch_seam(files) + run_meta_resilience_seam(files))
+            + run_wbatch_seam(files) + run_meta_resilience_seam(files)
+            + run_gateway_seam(files))
 
 
 PASS = Pass(
     name="seams",
     rules=("qos-seam", "resilience-seam", "ingest-seam", "compress-seam",
            "meta-cache-seam", "prefetch-seam", "wbatch-seam",
-           "meta-resilience-seam"),
+           "meta-resilience-seam", "gateway-seam"),
     run=run,
     doc="architecture seams: scheduler-only pools, resilience-wrapped "
         "stores, ingest-guarded uploads, plane-routed compression, "
         "cache-routed vfs attr reads, prefetch-routed speculative reads, "
-        "batcher-routed vfs write mutations, guard-routed engine calls",
+        "batcher-routed vfs write mutations, guard-routed engine calls, "
+        "streaming/admitted gateway data paths",
 )

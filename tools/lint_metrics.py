@@ -32,6 +32,8 @@ META_WBATCH_PREFIX = _metrics.META_WBATCH_PREFIX
 META_WBATCH_EXPECTED = _metrics.META_WBATCH_EXPECTED
 COMPRESS_PREFIX = _metrics.COMPRESS_PREFIX
 COMPRESS_EXPECTED = _metrics.COMPRESS_EXPECTED
+GATEWAY_PREFIX = _metrics.GATEWAY_PREFIX
+GATEWAY_EXPECTED = _metrics.GATEWAY_EXPECTED
 
 _PKG_ROOT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "juicefs_tpu"
@@ -69,6 +71,11 @@ def lint_compress(registry=None) -> list[str]:
                                 "compress", registry)
 
 
+def lint_gateway(registry=None) -> list[str]:
+    return _metrics.lint_pinned(GATEWAY_PREFIX, GATEWAY_EXPECTED,
+                                "gateway", registry)
+
+
 def lint_compress_seam(root: str | None = None) -> list[str]:
     """No-bare-compress check (ISSUE 8), framework-backed."""
     files = load_files(root or _PKG_ROOT)
@@ -100,7 +107,7 @@ def main() -> int:
                 + lint_ingest_seam() + lint_resilience()
                 + lint_qos() + lint_qos_seam()
                 + lint_compress() + lint_compress_seam()
-                + lint_wbatch())
+                + lint_wbatch() + lint_gateway())
     if problems:
         for p in problems:
             print(f"lint_metrics: {p}", file=sys.stderr)

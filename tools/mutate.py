@@ -123,6 +123,14 @@ TEST_MAP = {
     # visibility, barrier/sticky-error contract, per-op replay, overload
     # shed, concurrent-writer coalescing are all drilled in test_wbatch
     "juicefs_tpu/meta/wbatch": ["tests/test_wbatch.py"],
+    # ISSUE 15: gateway serving plane — admission/shed, range semantics,
+    # ordered pagination walker, streaming bounds and tenancy are drilled
+    # in test_gateway_plane; the s3 adapter also faces the protocol
+    # round-trips in test_fs_gateway and the SigV4 golden vectors
+    "juicefs_tpu/gateway/serve": ["tests/test_gateway_plane.py",
+                                  "tests/test_golden_signatures.py"],
+    "juicefs_tpu/gateway/s3": ["tests/test_gateway_plane.py",
+                               "tests/test_fs_gateway.py"],
     # ISSUE 8: batched compression plane + adaptive elision bypass
     "juicefs_tpu/tpu/compress_batch": ["tests/test_compress_batch.py"],
     "juicefs_tpu/chunk/bypass": ["tests/test_ingest.py", "-k",
