@@ -239,6 +239,20 @@ class HotContentCache:
             _fp, (_d, r) = self._map.popitem(last=False)
             self._bytes -= len(r)
 
+    def export(self, limit: int = 4096) -> list[tuple[bytes, bytes]]:
+        """MRU-first (fp, digest) rows for persistence (ISSUE 20):
+        digestless probe parkings are skipped — only proven content is
+        worth re-priming a mount with."""
+        with self._lock:
+            out = []
+            for fp, (digest, _raw) in reversed(self._map.items()):
+                if digest is None:
+                    continue
+                out.append((fp, digest))
+                if len(out) >= limit:
+                    break
+            return out
+
     def stats(self) -> dict:
         with self._lock:
             return {"entries": len(self._map), "bytes": self._bytes,
@@ -367,6 +381,9 @@ class IngestPipeline:
         self.passthrough = 0
         self.race_collapsed = 0
         self.errors = 0
+        # hot-content persistence accounting (ISSUE 20, stats-only)
+        self.hot_loaded = 0
+        self.hot_persisted = 0
         _LIVE_PIPELINES.add(self)
         self._thread = threading.Thread(
             target=self._loop, name="ingest-dedup", daemon=True
@@ -477,6 +494,13 @@ class IngestPipeline:
 
     # -- worker ------------------------------------------------------------
     def _loop(self) -> None:
+        try:
+            # warm the hot-content cache from the persisted snapshot
+            # (ISSUE 20): on the worker thread, before the first batch —
+            # mount never blocks on it, and no extra thread to leak
+            self._load_hot()
+        except Exception as e:
+            logger.warning("hot-content cache load skipped: %s", e)
         for batch in self._batcher.batches():
             try:
                 self._process(batch)
@@ -510,21 +534,20 @@ class IngestPipeline:
         raws = [batch[i][1] for i in unknown]
         packed = None
         if raws and pipe.device_backend:
-            # shared H2D (ISSUE 8): ONE pack_blocks upload feeds the hash
-            # digests AND the compress plane's device estimator. The
-            # device_put is what makes the sharing real — passing host
-            # numpy arrays to two separate jitted fns would transfer the
-            # batch twice.
+            # shared H2D (ISSUE 8/20): ONE pack_blocks upload feeds the
+            # hash digests AND the compress plane's device estimator. The
+            # placement goes through the sharding plane (`shard_packed`),
+            # which pads ragged batches to the mesh's data axis and does
+            # one *sharded* device_put — passing host numpy arrays to two
+            # separate jitted fns would transfer the batch twice.
             from ..tpu.jth256 import pack_blocks
 
             packed = pack_blocks(raws, pad_lanes=pipe.config.pad_lanes)
             try:
-                import jax
-
-                packed = tuple(jax.device_put(a) for a in packed)
+                packed = pipe.shard_packed(packed)
             except Exception as e:
                 # host arrays still work, just without the shared H2D
-                logger.debug("device_put sharing degraded: %s", e)
+                logger.debug("sharded placement degraded: %s", e)
         if raws:
             with _TR.span("chunk", "ingest", stage="hash",
                           hist=_H_HASH) as sp:
@@ -532,7 +555,7 @@ class IngestPipeline:
                     sp.set(blocks=len(raws), backend=self.backend,
                            hot_hits=len(batch) - len(raws))
                 if packed is not None:
-                    hashed = pipe.hash_packed(*packed)
+                    hashed = pipe.hash_packed(*packed, n=len(raws))
                 else:
                     hashed = pipe.hash_blocks(raws)
             for j, i in enumerate(unknown):
@@ -824,6 +847,65 @@ class IngestPipeline:
         self._passthrough(m[0], m[1], m[2], m[3],
                           pool=self.store._ingest_pool)
 
+    # -- hot-content persistence (ISSUE 20) --------------------------------
+    def _load_hot(self) -> None:
+        """Re-prime the hot cache from the meta snapshot written by the
+        previous mount's close(). Every row is re-verified before use:
+        the digest must still resolve to a live canonical via the
+        content-ref plane, the bytes come back through the store's own
+        read path, and the recomputed sampled fingerprint must match —
+        a stale snapshot costs nothing but this loader's time."""
+        hot = self._hot
+        meta = getattr(self.refs, "meta", None)
+        loader = getattr(meta, "load_hot_fingerprints", None)
+        if hot is None or loader is None:
+            return
+        rows = loader()
+        if not rows:
+            return
+        from .cached_store import block_key
+
+        canon = {}
+        for digest, (sid, indx, bsize), refs in meta.scan_content_refs():
+            if refs > 0:
+                canon[digest] = (sid, indx, bsize)
+        budget = hot._cap
+        for fp, digest in rows:
+            if budget <= 0 or self._closed:
+                break
+            loc = canon.get(digest)
+            if loc is None:
+                continue
+            sid, indx, bsize = loc
+            try:
+                raw = self.store._load_block(
+                    block_key(sid, indx, bsize), bsize, cache_after=False)
+            except Exception as e:
+                # canonical unreadable: skip the row — the snapshot is
+                # advisory, but say so (a storage fault burst here should
+                # be visible, not silent)
+                logger.debug("hot-cache reprime skipped %s_%s: %s",
+                             sid, indx, e)
+                continue
+            if raw is None or hot._fp(raw) != fp:
+                continue
+            hot.insert(fp, digest, bytes(raw))
+            budget -= len(raw)
+            self.hot_loaded += 1
+
+    def _persist_hot(self) -> None:
+        """Snapshot the hot cache's proven (fp, digest) rows to meta so
+        the next mount starts warm. Advisory end to end: an engine
+        without the API, or a failed txn, only loses the warm start."""
+        hot = self._hot
+        meta = getattr(self.refs, "meta", None)
+        saver = getattr(meta, "set_hot_fingerprints", None)
+        if hot is None or saver is None:
+            return
+        rows = hot.export()
+        saver(rows)
+        self.hot_persisted = len(rows)
+
     # -- lifecycle ---------------------------------------------------------
     def flush(self, timeout: float = 60.0) -> None:
         """Block until every submitted block is durable (elided, uploaded
@@ -852,6 +934,10 @@ class IngestPipeline:
             self._thread.join(timeout)
             self._finalq.put(None)
             self._finalizer.join(timeout)
+            try:
+                self._persist_hot()  # after drain: snapshot is complete
+            except Exception as e:
+                logger.warning("hot-content cache persist skipped: %s", e)
 
     def stats(self) -> dict:
         out = {
@@ -867,7 +953,11 @@ class IngestPipeline:
         if self.governor is not None:
             out["bypass"] = self.governor.stats()
         if self._hot is not None:
-            out["hot_content"] = self._hot.stats()
+            out["hot_content"] = dict(
+                self._hot.stats(),
+                loaded=self.hot_loaded,
+                persisted=self.hot_persisted,
+            )
         plane = getattr(self.store, "compress_plane", None)
         if plane is not None:
             out["compress"] = plane.stats()

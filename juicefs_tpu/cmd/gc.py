@@ -252,6 +252,10 @@ def dedup_scan(meta, store, live: dict[str, int], backend: str,
         # through object/resilient.py): a scan that paid for fault
         # handling must say so next to its throughput numbers
         "resilience": resilience_snapshot(),
+        # sharding-plane geometry the hash batches ran on (ISSUE 20):
+        # device count, mesh axes, and whether the plane degraded to
+        # single-device jit
+        "shard": pipe.shard_snapshot(),
     }
 
 

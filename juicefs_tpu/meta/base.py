@@ -193,6 +193,22 @@ class BaseMeta(interface.Meta):
     def content_set_refs(self, digest: bytes, refs: int) -> None: ...
     def content_delete_aliases(self, pairs: list[tuple[int, int]]) -> None: ...
 
+    # -- hot-content fingerprint persistence (ISSUE 20) --------------------
+    # Advisory snapshot of the ingest hot-content cache's (sampled-fp,
+    # digest) pairs so a remount starts warm instead of re-hashing the
+    # same hot blocks. Purely an optimization surface: engines without
+    # support no-op, a stale or lost snapshot only costs hash work, and
+    # the loader re-verifies every entry against live content refs before
+    # trusting it.
+    def set_hot_fingerprints(
+        self, rows: list[tuple[bytes, bytes]]
+    ) -> None:
+        """Replace the persisted hot-content snapshot (fp32, digest32)."""
+
+    def load_hot_fingerprints(self) -> list[tuple[bytes, bytes]]:
+        """Return the persisted snapshot, MRU-first; [] when absent."""
+        return []
+
     # -- lifecycle ---------------------------------------------------------
     def name(self) -> str:
         return "base"

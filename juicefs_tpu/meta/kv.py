@@ -1497,6 +1497,31 @@ class KVMeta(BaseMeta):
 
             self.client.txn(fn)
 
+    # ---- hot-content fingerprint snapshot (ISSUE 20) ---------------------
+    # One advisory blob under a single key (like the Format under
+    # b"setting"): 64 bytes per row (fp32 + digest32), MRU-first, replaced
+    # wholesale at unmount. Single-txn either way — the snapshot is small
+    # (bounded by the persist limit) and internally order-dependent.
+
+    def set_hot_fingerprints(self, rows: list[tuple[bytes, bytes]]) -> None:
+        blob = b"".join(fp + digest for fp, digest in rows)
+
+        def fn(tx: KVTxn):
+            if blob:
+                tx.set(b"hotfp", blob)
+            else:
+                tx.delete(b"hotfp")
+            return 0
+
+        self.client.txn(fn)
+
+    def load_hot_fingerprints(self) -> list[tuple[bytes, bytes]]:
+        blob = self.client.txn(lambda tx: tx.get(b"hotfp")) or b""
+        return [
+            (bytes(blob[i:i + 32]), bytes(blob[i + 32:i + 64]))
+            for i in range(0, len(blob) - len(blob) % 64, 64)
+        ]
+
     # ---- content-ref plane (inline ingest dedup, ISSUE 5) ----------------
     # H{digest} rows count every block whose bytes are served by one
     # canonical stored object; G{sid,indx} alias rows let the read and

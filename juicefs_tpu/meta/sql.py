@@ -1569,6 +1569,34 @@ class SQLMeta(BaseMeta):
 
             self._txn(fn)
 
+    # ---- hot-content fingerprint snapshot (ISSUE 20) ---------------------
+    # Relational mirror of kv.py's b"hotfp" blob: one setting-table row,
+    # 64 bytes per (fp32, digest32) entry MRU-first, replaced wholesale.
+
+    def set_hot_fingerprints(self, rows: list[tuple[bytes, bytes]]) -> None:
+        blob = b"".join(fp + digest for fp, digest in rows)
+
+        def fn(cur):
+            if blob:
+                cur.execute(
+                    "INSERT OR REPLACE INTO setting (name, value) "
+                    "VALUES ('hotfp', ?)", (blob,))
+            else:
+                cur.execute("DELETE FROM setting WHERE name='hotfp'")
+            return 0
+
+        self._txn(fn)
+
+    def load_hot_fingerprints(self) -> list[tuple[bytes, bytes]]:
+        row = self._rtxn(lambda cur: cur.execute(
+            "SELECT value FROM setting WHERE name='hotfp'"
+        ).fetchone())
+        blob = bytes(row[0]) if row else b""
+        return [
+            (blob[i:i + 32], blob[i + 32:i + 64])
+            for i in range(0, len(blob) - len(blob) % 64, 64)
+        ]
+
     # ---- content-ref plane (inline ingest dedup, ISSUE 5) ----------------
     # Relational mirror of the KV engine's H/G keyspace: contentref counts
     # every block served by one canonical stored object; contentalias rows

@@ -12,7 +12,9 @@ Modules:
   hash_jax  — batched jit/pallas implementations of the same spec
   dedup     — sort-based duplicate scan over digest batches
   pipeline  — double-buffered host->device streaming hash pipeline
-  sharding  — device-mesh helpers (data x lane axes) for multi-chip scans
+  sharding  — the multichip plane: (data x lane) mesh over all local
+              devices, sharded placement + hash/dedup/estimator programs,
+              single-device-jit degrade ladder (ISSUE 20)
 """
 
 from .jth256 import (
@@ -26,7 +28,15 @@ from .jth256 import (
 from .hash_jax import hash_blocks_jax, hash_packed_jax, make_hash_fn
 from .dedup import dedup_digests, dedup_scan_jax
 from .pipeline import HashPipeline, PipelineConfig
-from .sharding import make_mesh, sharded_scan_step
+from .sharding import (
+    ShardedPack,
+    ShardPlane,
+    get_plane,
+    make_mesh,
+    shard_batch,
+    sharded_hash_step,
+    sharded_scan_step,
+)
 
 __all__ = [
     "BLOCK_BYTES",
@@ -43,5 +53,10 @@ __all__ = [
     "HashPipeline",
     "PipelineConfig",
     "make_mesh",
+    "shard_batch",
+    "sharded_hash_step",
     "sharded_scan_step",
+    "ShardedPack",
+    "ShardPlane",
+    "get_plane",
 ]

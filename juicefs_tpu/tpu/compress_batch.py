@@ -132,7 +132,13 @@ class CompressPlane:
         self._est_fn = None
         if self.config.backend == "xla" and self.active:
             try:
-                self._est_fn = _make_estimator()
+                # the estimator comes off the sharding plane (ISSUE 20):
+                # pjit-sharded over the mesh when the shared pack divides,
+                # the same single-device jit as before otherwise —
+                # advisories identical either way
+                from .sharding import get_plane
+
+                self._est_fn = get_plane().make_estimator()
             except Exception as e:
                 # no usable accelerator: compressed bytes must still flow,
                 # so drop to the lane-parallel CPU plane (byte-identical
@@ -252,6 +258,11 @@ class CompressPlane:
 
             words, counts, _lengths = packed
             pred = np.asarray(self._est_fn(words, counts))
+            # a plane-placed pack (ShardedPack) was padded to the mesh's
+            # data-axis extent; slice the advisory back to the real batch
+            n = getattr(packed, "batch", None)
+            if n is not None:
+                pred = pred[:n]
             with self._lock:
                 self.estimated += len(pred)
                 self.last_estimate = [round(float(p), 4) for p in pred]

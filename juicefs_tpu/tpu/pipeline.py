@@ -70,14 +70,24 @@ class HashPipeline:
     def __init__(self, config: Optional[PipelineConfig] = None):
         self.config = config or PipelineConfig()
         self._fn = None
+        self._plane = None
         if self.config.backend != "cpu":
             try:
                 import jax
 
                 jax.devices()  # force backend init; may raise
-                from .hash_jax import make_hash_fn
+                if self.config.backend == "xla":
+                    # xla rides the sharding plane (ISSUE 20): mesh over
+                    # all local devices, single-device jit on the degrade
+                    # rung — byte-identical either way.
+                    from .sharding import get_plane
 
-                self._fn = make_hash_fn(self.config.backend)
+                    self._plane = get_plane()
+                    self._fn = self._plane.hash_async
+                else:
+                    from .hash_jax import make_hash_fn
+
+                    self._fn = make_hash_fn(self.config.backend)
             except Exception as e:  # no usable accelerator: digests must
                 # still flow, so degrade to the byte-identical CPU path.
                 from ..utils import get_logger
@@ -87,6 +97,7 @@ class HashPipeline:
                     self.config.backend, e,
                 )
                 self.config.backend = "cpu"
+                self._plane = None
 
     def hash_stream(
         self, items: Iterable[tuple[str, bytes]]
@@ -161,16 +172,49 @@ class HashPipeline:
         """True when digests come off an accelerator (post-degrade)."""
         return self._fn is not None
 
-    def hash_packed(self, words, counts, lengths) -> list[bytes]:
+    def shard_packed(self, packed):
+        """Place a packed triple on devices for the shared-H2D contract
+        (ISSUE 8/20): ONE (sharded, on the plane) device transfer feeds
+        both the hash and the estimator jits. This is the sharding-plane
+        seam chunk/ consumers enter through — no bare device_put above
+        tpu/. cpu backend: no-op (host arrays hash in numpy)."""
+        if self._plane is not None:
+            return self._plane.put_packed(*packed)
+        if self._fn is not None:  # single-device backend (pallas)
+            try:
+                import jax
+
+                return tuple(jax.device_put(a) for a in packed)
+            except Exception:
+                return packed
+        return packed
+
+    def shard_snapshot(self) -> dict:
+        """Advisory sharding-plane stats (gc --dedup, bench output)."""
+        if self._plane is not None:
+            return self._plane.snapshot()
+        return {
+            "devices": 1 if self._fn is not None else 0,
+            "mesh": None,
+            "degraded": False,
+            "reason": f"{self.config.backend} backend",
+        }
+
+    def hash_packed(self, words, counts, lengths,
+                    n: int | None = None) -> list[bytes]:
         """Digest a pre-packed batch (shared-H2D contract, ISSUE 8): the
         caller packs once and the SAME upload feeds hash and compress
         outputs. On the cpu backend this is the vectorized numpy path —
-        byte-identical, no transfer (h2d counter untouched)."""
-        n = len(counts)
+        byte-identical, no transfer (h2d counter untouched). `n` is the
+        original batch size when the input was padded by the sharding
+        plane (`shard_packed`); outputs are sliced back to it."""
+        if n is None:
+            n = int(getattr(words, "shape", [len(counts)])[0])
         with _TR.span("tpu", "hash", stage="dispatch",
                       hist=_H_DISPATCH) as sp:
+            nbytes = int(np.asarray(lengths)[:n].sum()) if n else 0
             if sp.active:
-                sp.set(batch=n, bytes=int(lengths.sum()),
+                sp.set(batch=n, bytes=nbytes,
                        backend=self.config.backend)
             if self._fn is None:
                 out = hash_packed_np(words, counts, lengths)
@@ -179,11 +223,11 @@ class HashPipeline:
                 out = self._fn(words, counts, lengths)
         _BATCH_BLOCKS.observe(n)
         _BLOCKS_HASHED.inc(n)
-        _HASH_BYTES.inc(int(lengths.sum()))
+        _HASH_BYTES.inc(nbytes)
         with _TR.span("tpu", "hash", stage="drain", hist=_H_DRAIN) as sp:
             if sp.active:
                 sp.set(batch=n, backend=self.config.backend)
-            return digests_to_bytes(np.asarray(out))
+            return digests_to_bytes(np.asarray(out))[:n]
 
 
 _FLUSH = object()  # kick(): hash whatever is buffered NOW (commit barrier)

@@ -2492,3 +2492,57 @@ def test_gateway_seam_real_tree_clean():
 
     assert not [f for f in seams.run_gateway_seam(files)], \
         [f.render() for f in seams.run_gateway_seam(files)]
+
+
+# ---------------------------------------------------------------------------
+# tpu-shard-seam (ISSUE 20): chunk/ device work routes through the plane
+
+def test_tpu_shard_seam_bare_device_calls_fire(tmp_path):
+    report = _run(tmp_path, {"chunk/ingest.py": """
+        import jax
+
+        class IngestPipeline:
+            def _process(self, batch):
+                packed = pack_blocks(raws)
+                packed = tuple(jax.device_put(a) for a in packed)
+                fn = jax.jit(hash_packed_jax)
+                return fn(*packed)
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "tpu-shard-seam"]
+    assert any("device_put" in m for m in msgs), msgs
+    assert any("bare jit" in m for m in msgs), msgs
+    # the positive half: the shared pack never reaches the plane seam
+    assert any("shard_packed" in m for m in msgs), msgs
+    assert any("estimate_packed" in m for m in msgs), msgs
+
+
+def test_tpu_shard_seam_routed_tree_clean(tmp_path):
+    report = _run(tmp_path, {"chunk/ingest.py": """
+        class IngestPipeline:
+            def _process(self, batch):
+                packed = pack_blocks(raws)
+                packed = pipe.shard_packed(packed)
+                hashed = pipe.hash_packed(*packed, n=len(raws))
+                plane.estimate_packed(packed)
+                return hashed
+    """})
+    assert not [f for f in report.findings if f.rule == "tpu-shard-seam"], \
+        report.findings
+
+
+def test_tpu_shard_seam_missing_process_fires(tmp_path):
+    report = _run(tmp_path, {"chunk/ingest.py": """
+        class IngestPipeline:
+            def submit(self, key, raw):
+                return None
+    """})
+    msgs = [f.message for f in report.findings if f.rule == "tpu-shard-seam"]
+    assert any("_process not found" in m for m in msgs), msgs
+
+
+def test_tpu_shard_seam_real_tree_clean():
+    files = load_files()
+    from tools.analyze.passes import seams
+
+    assert not [f for f in seams.run_tpu_shard_seam(files)], \
+        [f.render() for f in seams.run_tpu_shard_seam(files)]

@@ -938,3 +938,77 @@ def test_governor_defaults_and_boundaries():
     for _ in range(3):  # 3 hits / 10 window = exactly high_water
         g.record(True)
     assert not g.bypassing
+
+
+def test_hot_content_cache_persists_across_mounts(meta, tmp_path):
+    """ISSUE 20: the sampled-fingerprint hot cache survives a remount —
+    close() snapshots (fp, digest) rows to meta, the next mount's worker
+    re-primes from live canonicals, and a re-presented hot block elides
+    its PUT without re-hashing through the pipeline."""
+    storage = create_storage(f"file://{tmp_path}/blob-hot")
+    storage.create()
+    counting = CountingStore(storage)
+    store = CachedStore(counting, ChunkConfig(block_size=BS, cache_size=1))
+    refs = ContentRefs(meta)
+    store.content_refs = refs
+    store.ingest = IngestPipeline(store, refs, backend="cpu",
+                                  batch_blocks=4, flush_timeout=0.005)
+    hot_blocks = [os.urandom(BS) for _ in range(3)]
+    _write(store, 970, *hot_blocks)
+    store.ingest.flush()
+    st = store.ingest.stats()
+    assert st["hot_content"]["entries"] == 3
+    store.close()  # persists the snapshot
+    assert store.ingest.hot_persisted == 3
+    assert len(meta.load_hot_fingerprints()) == 3
+
+    # remount: fresh store + pipeline over the same meta/objects
+    counting2 = CountingStore(storage)
+    store2 = CachedStore(counting2, ChunkConfig(block_size=BS, cache_size=1))
+    refs2 = ContentRefs(meta)
+    store2.content_refs = refs2
+    store2.ingest = IngestPipeline(store2, refs2, backend="cpu",
+                                   batch_blocks=4, flush_timeout=0.005)
+    try:
+        deadline = time.time() + 10
+        while store2.ingest.hot_loaded < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert store2.ingest.hot_loaded == 3
+        hashed_before = store2.ingest._batcher.pipe  # hot hits skip this
+        _write(store2, 971, *hot_blocks)
+        store2.ingest.flush()
+        st2 = store2.ingest.stats()
+        # all three blocks matched the warm cache (no re-hash) and elided
+        assert st2["hot_content"]["hits"] == 3
+        assert st2["put_elided"] == 3
+        assert not [k for k in counting2.put_keys if "971" in k]
+        del hashed_before
+    finally:
+        store2.close()
+
+
+def test_hot_persistence_stale_snapshot_is_harmless(meta, tmp_path):
+    """A snapshot whose digests no longer resolve (content deleted) is
+    skipped row by row — the loader verifies against live content refs
+    and recomputed fingerprints, never trusts the blob."""
+    # fabricate a snapshot pointing at content that never existed
+    meta.set_hot_fingerprints([(os.urandom(32), os.urandom(32))])
+    storage = create_storage(f"file://{tmp_path}/blob-stale")
+    storage.create()
+    store = CachedStore(CountingStore(storage),
+                        ChunkConfig(block_size=BS, cache_size=1))
+    refs = ContentRefs(meta)
+    store.content_refs = refs
+    store.ingest = IngestPipeline(store, refs, backend="cpu",
+                                  batch_blocks=4, flush_timeout=0.005)
+    try:
+        data = os.urandom(BS)
+        _write(store, 975, data)
+        store.ingest.flush()
+        assert store.ingest.hot_loaded == 0
+        assert store.ingest.errors == 0
+    finally:
+        store.close()
+    # empty-cache close clears gracefully too
+    meta.set_hot_fingerprints([])
+    assert meta.load_hot_fingerprints() == []

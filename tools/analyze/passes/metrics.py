@@ -136,6 +136,15 @@ GATEWAY_EXPECTED = {
     "juicefs_gateway_inflight",
     "juicefs_gateway_stream_buffer_bytes",
 }
+TPU_SHARD_PREFIX = "juicefs_tpu_shard_"
+TPU_SHARD_EXPECTED = {
+    # multichip sharding plane (ISSUE 20, tpu/sharding.py): device/mesh
+    # geometry, the ONE-sharded-transfer-per-batch counter the shared-pack
+    # contract asserts, and the single-device-jit degrade counter
+    "juicefs_tpu_shard_devices",
+    "juicefs_tpu_shard_h2d_batches",
+    "juicefs_tpu_shard_degraded",
+}
 META_WBATCH_PREFIX = "juicefs_meta_wbatch_"
 META_WBATCH_EXPECTED = {
     # checkpoint write plane (ISSUE 13, meta/wbatch.py): the
@@ -174,6 +183,7 @@ def populate_registry() -> None:
     import juicefs_tpu.qos.scheduler        # noqa: F401  scheduler classes
     import juicefs_tpu.tpu.compress_batch   # noqa: F401  compression plane
     import juicefs_tpu.tpu.pipeline         # noqa: F401  batch metrics
+    import juicefs_tpu.tpu.sharding         # noqa: F401  multichip plane
     import juicefs_tpu.vfs.reader           # noqa: F401  readahead/streaming
     from juicefs_tpu.metric import register_process_metrics
 
@@ -239,6 +249,7 @@ def run(files: list[SourceFile]) -> list[Finding]:
         + lint_pinned(META_STALE_PREFIX, META_STALE_EXPECTED, "meta-stale")
         + lint_pinned(META_WBATCH_PREFIX, META_WBATCH_EXPECTED,
                       "meta-wbatch")
+        + lint_pinned(TPU_SHARD_PREFIX, TPU_SHARD_EXPECTED, "tpu-shard")
         + lint_pinned(PREFETCH_PREFIX, PREFETCH_EXPECTED, "prefetch")
         + lint_pinned(READAHEAD_PREFIX, READAHEAD_EXPECTED, "readahead")
         + lint_pinned(GATEWAY_PREFIX, GATEWAY_EXPECTED, "gateway")

@@ -26,6 +26,10 @@ shim over these.
   refactor that quietly drops any of these reverts every mutation to
   one transaction per op, which no functional test catches — results
   stay identical, only the round trips regress).
+* ``tpu-shard-seam`` (ISSUE 20): device placement in ``chunk/`` routes
+  through the sharding plane — no bare ``jax.jit``/``device_put``/
+  ``pjit``, and the ingest shared pack must reach ``shard_packed`` and
+  ``estimate_packed``.
 """
 
 from __future__ import annotations
@@ -34,7 +38,14 @@ import ast
 import re
 from typing import Optional
 
-from ..core import Finding, Pass, SourceFile, call_name, parent_map
+from ..core import (
+    Finding,
+    Pass,
+    SourceFile,
+    attr_chain,
+    call_name,
+    parent_map,
+)
 
 # pools allowed to exist OUTSIDE the unified scheduler (paths relative
 # to the analysis root, i.e. the package dir):
@@ -695,23 +706,90 @@ def run_gateway_seam(files: list[SourceFile]) -> list[Finding]:
     return findings
 
 
+# device entrypoints chunk/ must not call directly: placement and jit
+# compilation belong to the sharding plane (tpu/sharding.py), which owns
+# the mesh, the ragged-batch padding, and the degrade ladder. A bare
+# device_put in chunk/ silently forks the shared-H2D contract (the batch
+# transfers twice, unsharded) — results stay identical, only the
+# transfer discipline vanishes, which no functional test catches.
+_SHARD_DEVICE_CALLS = {"device_put", "pjit", "make_mesh"}
+
+
+def run_tpu_shard_seam(files: list[SourceFile]) -> list[Finding]:
+    """Hash/dedup/estimator consumers in chunk/ enter through the
+    sharding plane (ISSUE 20): no bare ``jax.jit``/``jax.device_put``/
+    ``pjit`` in chunk/, and the ingest worker's shared pack must reach
+    the plane seam (``shard_packed``) and feed the estimator from it
+    (``estimate_packed``)."""
+    findings: list[Finding] = []
+    ingest_sf = None
+    saw_pkg = False
+    for sf in files:
+        saw_pkg = saw_pkg or sf.rel.startswith("juicefs_tpu/")
+        rel = _pkg_rel(sf)
+        if rel == "chunk/ingest.py":
+            ingest_sf = sf
+        if not rel.startswith("chunk/") or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            chain = attr_chain(node.func) or []
+            if name in _SHARD_DEVICE_CALLS or (
+                    name == "jit" and chain[:1] == ["jax"]):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "tpu-shard-seam",
+                    f"bare {name} in chunk/ — device placement and jit "
+                    "belong to the sharding plane (route through "
+                    "HashPipeline.shard_packed / tpu.sharding.get_plane)",
+                ))
+    if ingest_sf is None or ingest_sf.tree is None:
+        if saw_pkg:
+            findings.append(Finding(
+                "juicefs_tpu/chunk/ingest.py", 0, "tpu-shard-seam",
+                "chunk/ingest.py not found or unparseable"))
+        return findings
+    proc = next(iter(_fn_defs(ingest_sf.tree, ("_process",))), None)
+    if proc is None:
+        findings.append(Finding(
+            ingest_sf.rel, 0, "tpu-shard-seam",
+            "IngestPipeline._process not found — the shared-pack seam "
+            "has no home"))
+        return findings
+    called = {call_name(n) for n in ast.walk(proc)
+              if isinstance(n, ast.Call)}
+    if "shard_packed" not in called:
+        findings.append(Finding(
+            ingest_sf.rel, proc.lineno, "tpu-shard-seam",
+            "_process never reaches shard_packed — the shared pack "
+            "bypasses the sharding plane (unsharded double transfer)"))
+    if "estimate_packed" not in called:
+        findings.append(Finding(
+            ingest_sf.rel, proc.lineno, "tpu-shard-seam",
+            "_process never feeds estimate_packed — the compress "
+            "estimator lost the shared-H2D pack"))
+    return findings
+
+
 def run(files: list[SourceFile]) -> list[Finding]:
     return (run_qos_seam(files) + run_resilience_seam(files)
             + run_ingest_seam(files) + run_compress_seam(files)
             + run_meta_cache_seam(files) + run_prefetch_seam(files)
             + run_wbatch_seam(files) + run_meta_resilience_seam(files)
-            + run_gateway_seam(files))
+            + run_gateway_seam(files) + run_tpu_shard_seam(files))
 
 
 PASS = Pass(
     name="seams",
     rules=("qos-seam", "resilience-seam", "ingest-seam", "compress-seam",
            "meta-cache-seam", "prefetch-seam", "wbatch-seam",
-           "meta-resilience-seam", "gateway-seam"),
+           "meta-resilience-seam", "gateway-seam", "tpu-shard-seam"),
     run=run,
     doc="architecture seams: scheduler-only pools, resilience-wrapped "
         "stores, ingest-guarded uploads, plane-routed compression, "
         "cache-routed vfs attr reads, prefetch-routed speculative reads, "
         "batcher-routed vfs write mutations, guard-routed engine calls, "
-        "streaming/admitted gateway data paths",
+        "streaming/admitted gateway data paths, plane-routed device "
+        "placement (no bare jit/device_put in chunk/)",
 )

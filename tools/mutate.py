@@ -56,7 +56,19 @@ TEST_MAP = {
                                        "tests/test_ingest.py"],
     "juicefs_tpu/chunk/ingest": ["tests/test_ingest.py"],
     "juicefs_tpu/tpu/pipeline": ["tests/test_tpu_hash.py",
-                                 "tests/test_ingest.py"],
+                                 "tests/test_ingest.py",
+                                 "tests/test_tpu_shard.py", "-k",
+                                 "not forced_host"],
+    # ISSUE 20: the multichip sharding plane. The in-process subset only
+    # (forced_host byte-identity tests respawn an 8-device interpreter
+    # per case — too slow for a mutant sweep; the in-process tests cover
+    # the same mesh through conftest's 8 forced host devices).
+    "juicefs_tpu/tpu/sharding": ["tests/test_tpu_shard.py", "-k",
+                                 "not forced_host",
+                                 "tests/test_tpu_hash.py"],
+    "juicefs_tpu/tpu/dedup": ["tests/test_tpu_hash.py",
+                              "tests/test_tpu_shard.py", "-k",
+                              "not forced_host"],
     "juicefs_tpu/chunk/disk_cache": ["tests/test_chunk.py"],
     "juicefs_tpu/object/resilient": ["tests/test_resilient.py",
                                      "tests/test_chaos.py"],
@@ -132,7 +144,9 @@ TEST_MAP = {
     "juicefs_tpu/gateway/s3": ["tests/test_gateway_plane.py",
                                "tests/test_fs_gateway.py"],
     # ISSUE 8: batched compression plane + adaptive elision bypass
-    "juicefs_tpu/tpu/compress_batch": ["tests/test_compress_batch.py"],
+    "juicefs_tpu/tpu/compress_batch": ["tests/test_compress_batch.py",
+                                       "tests/test_tpu_shard.py", "-k",
+                                       "not forced_host"],
     "juicefs_tpu/chunk/bypass": ["tests/test_ingest.py", "-k",
                                  "governor or bypass"],
     "juicefs_tpu/compress/__init__": ["tests/test_compress_batch.py"],
